@@ -1,0 +1,21 @@
+"""FeatureNet-TPU: a TPU-native machining-feature-recognition framework.
+
+A ground-up JAX/Flax/XLA re-design of the capabilities of the FeatureNet
+reference (yqtianust/FeatureNet — 3D-CNN recognition of 24 machining feature
+classes over voxelized CAD parts; see SURVEY.md). Nothing here is a port: the
+compute path is Flax modules lowered to XLA (MXU-friendly NDHWC, bf16 compute /
+fp32 state), the distributed path is `jax.sharding.Mesh` + `jit`/`shard_map`
+with XLA collectives over ICI (not NCCL), and the data path is a first-party
+STL→voxel pipeline with a native C++ rasterizer option.
+
+Subpackages
+-----------
+- ``featurenet_tpu.data``     — STL parsing, voxelization, synthetic dataset
+- ``featurenet_tpu.models``   — Flax model families (classifier, segmentation)
+- ``featurenet_tpu.ops``      — custom ops / Pallas TPU kernels
+- ``featurenet_tpu.parallel`` — mesh, sharding, collectives, spatial partitioning
+- ``featurenet_tpu.train``    — configs, train state, steps, loop, checkpointing
+- ``featurenet_tpu.utils``    — metrics, logging, misc
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""Data pipeline: STL parsing, voxelization, synthetic feature generation."""
+
+from featurenet_tpu.data.stl import load_stl, save_stl
+from featurenet_tpu.data.voxelize import normalize_mesh, voxelize
+from featurenet_tpu.data.synthetic import (
+    CLASS_NAMES,
+    NUM_CLASSES,
+    generate_sample,
+    generate_batch,
+)
+from featurenet_tpu.data.dataset import SyntheticVoxelDataset, prefetch_to_device
+
+__all__ = [
+    "load_stl",
+    "save_stl",
+    "normalize_mesh",
+    "voxelize",
+    "CLASS_NAMES",
+    "NUM_CLASSES",
+    "generate_sample",
+    "generate_batch",
+    "SyntheticVoxelDataset",
+    "prefetch_to_device",
+]

@@ -1,0 +1,183 @@
+"""Host-side input pipeline feeding device-resident voxel batches.
+
+The reference used a ``torch.utils.data.Dataset`` + ``DataLoader`` with a
+``DistributedSampler`` (SURVEY.md §2 C3/C5). The TPU-native shape of that is:
+each *host* produces only its shard of the global batch, batches are built in
+background threads, and arrays land in HBM via ``jax.device_put`` with the
+batch's ``NamedSharding`` — so the addressable slice of a globally-sharded
+batch is exactly what this host generated, and XLA never sees a host→host
+copy. On a single host the same code degenerates to plain prefetching.
+
+Threading model: parallel workers never share an iterator. Each worker owns an
+independent, seed-decorrelated stream (``SyntheticVoxelDataset.worker_iter``)
+and a fixed residue class of the ticket space (worker w fills tickets
+w, w+W, w+2W, …), so the merged stream is deterministic for a given
+(seed, num_workers) regardless of thread scheduling. Worker exceptions and
+exhaustion propagate to the consumer instead of hanging it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from featurenet_tpu.data.synthetic import generate_batch
+
+
+class SyntheticVoxelDataset:
+    """Infinite, seeded, sharded stream of synthetic feature batches.
+
+    Args:
+      resolution: voxel grid edge (16/32/64/128).
+      global_batch: total batch across all hosts.
+      num_hosts / host_id: data-parallel process grid; this host generates
+        ``global_batch // num_hosts`` samples per step, decorrelated by seed.
+      num_features: 1 for classification, >1 for segmentation parts.
+      seed: base seed; per-host and per-worker streams are independent
+        ``SeedSequence`` folds of it.
+    """
+
+    def __init__(
+        self,
+        resolution: int = 64,
+        global_batch: int = 96,
+        num_hosts: int = 1,
+        host_id: int = 0,
+        num_features: int = 1,
+        balanced: bool = True,
+        seed: int = 0,
+    ):
+        if global_batch % num_hosts != 0:
+            raise ValueError("global_batch must divide evenly across hosts")
+        self.resolution = resolution
+        self.global_batch = global_batch
+        self.local_batch = global_batch // num_hosts
+        self.num_features = num_features
+        self.balanced = balanced
+        self.seed = seed
+        self.host_id = host_id
+
+    def worker_iter(
+        self, worker_id: int = 0, num_workers: int = 1
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """An independent infinite stream for one producer worker."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.host_id, worker_id])
+        )
+        while True:
+            yield generate_batch(
+                rng,
+                self.local_batch,
+                self.resolution,
+                balanced=self.balanced,
+                num_features=self.num_features,
+            )
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return self.worker_iter(0, 1)
+
+
+class _WorkerDone:
+    pass
+
+
+def prefetch_to_device(
+    source,
+    sharding=None,
+    buffer_size: int = 2,
+    num_workers: int = 1,
+) -> Iterator[dict]:
+    """Overlap host-side batch generation with device compute.
+
+    Args:
+      source: a ``SyntheticVoxelDataset`` (or any object with ``worker_iter``)
+        for multi-worker production, or a plain iterator/iterable (then
+        ``num_workers`` is capped at 1 — a shared iterator is not thread-safe).
+      sharding: optional ``jax.sharding.Sharding``; batches are ``device_put``
+        with it. None leaves batches on host (CPU tests).
+      buffer_size: max ready-but-unconsumed batches per worker.
+      num_workers: producer threads; numpy releases the GIL for the heavy
+        boolean ops so generation genuinely parallelizes.
+
+    Termination: a finite source ends the stream cleanly (StopIteration);
+    a producer exception re-raises in the consumer.
+    """
+    import jax
+
+    if hasattr(source, "worker_iter"):
+        W = max(1, num_workers)
+        iters = [source.worker_iter(w, W) for w in range(W)]
+    else:
+        W = 1
+        iters = [iter(source)]
+
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    out: dict[int, object] = {}  # ticket -> batch | _WorkerDone | Exception
+    stop = threading.Event()
+    # Each producer may run at most `lookahead` tickets past the consumer.
+    # Bounding lookahead (not total buffer occupancy) is what makes this
+    # deadlock-free: the worker owning the ticket the consumer waits on is
+    # by construction within bounds and can always make progress.
+    lookahead = max(1, buffer_size) * W
+    nxt_box = [0]  # consumer's next ticket, shared under `cond`
+
+    def producer(w: int):
+        ticket = w
+        try:
+            for item in iters[w]:
+                with cond:
+                    while (
+                        ticket >= nxt_box[0] + lookahead and not stop.is_set()
+                    ):
+                        cond.wait(0.1)
+                    if stop.is_set():
+                        return
+                    out[ticket] = item
+                    cond.notify_all()
+                ticket += W
+            result: object = _WorkerDone()
+        except BaseException as e:  # propagate to consumer, don't hang it
+            result = e
+        with cond:
+            out[ticket] = result
+            cond.notify_all()
+
+    threads = [
+        threading.Thread(target=producer, args=(w,), daemon=True)
+        for w in range(W)
+    ]
+    for t in threads:
+        t.start()
+
+    done_workers: set[int] = set()
+    nxt = 0
+    try:
+        while len(done_workers) < W:
+            if nxt % W in done_workers:
+                nxt += 1
+                with cond:
+                    nxt_box[0] = nxt
+                    cond.notify_all()
+                continue
+            with cond:
+                while nxt not in out:
+                    cond.wait(0.1)
+                item = out.pop(nxt)
+                nxt_box[0] = nxt + 1
+                cond.notify_all()
+            if isinstance(item, _WorkerDone):
+                done_workers.add(nxt % W)
+            elif isinstance(item, BaseException):
+                raise item
+            else:
+                if sharding is not None:
+                    item = jax.device_put(item, sharding)
+                yield item
+            nxt += 1
+    finally:
+        stop.set()
+        with cond:
+            cond.notify_all()

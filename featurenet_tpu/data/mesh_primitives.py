@@ -1,0 +1,65 @@
+"""Triangle-mesh emitters for simple solids, used to exercise the STL→voxel path.
+
+The synthetic trainer carves features directly in voxel space (``synthetic.py``)
+but the framework must also support the reference's actual input modality —
+STL files on disk (SURVEY.md §3.2). These generators produce watertight
+triangle soups for boxes and cylinders so tests can round-trip
+mesh → ``save_stl`` → ``load_stl`` → ``voxelize`` and compare against the
+analytic occupancy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def mesh_box(lo=(0.2, 0.2, 0.2), hi=(0.8, 0.8, 0.8)) -> np.ndarray:
+    """12-triangle watertight axis-aligned box, ``[12, 3, 3]`` float32."""
+    x0, y0, z0 = lo
+    x1, y1, z1 = hi
+    # 8 corners.
+    c = np.array(
+        [
+            [x0, y0, z0], [x1, y0, z0], [x1, y1, z0], [x0, y1, z0],
+            [x0, y0, z1], [x1, y0, z1], [x1, y1, z1], [x0, y1, z1],
+        ],
+        dtype=np.float32,
+    )
+    quads = [
+        (0, 3, 2, 1),  # z0 (floor, outward -z)
+        (4, 5, 6, 7),  # z1
+        (0, 1, 5, 4),  # y0
+        (2, 3, 7, 6),  # y1
+        (0, 4, 7, 3),  # x0
+        (1, 2, 6, 5),  # x1
+    ]
+    tris = []
+    for a, b, cc, d in quads:
+        tris.append([c[a], c[b], c[cc]])
+        tris.append([c[a], c[cc], c[d]])
+    return np.asarray(tris, dtype=np.float32)
+
+
+def mesh_cylinder(
+    center=(0.5, 0.5), radius=0.25, z0=0.2, z1=0.8, segments: int = 48
+) -> np.ndarray:
+    """Closed cylinder along z as a triangle soup, ``[4*segments, 3, 3]``."""
+    cx, cy = center
+    ang = np.linspace(0.0, 2 * np.pi, segments, endpoint=False)
+    nxt = np.roll(np.arange(segments), -1)
+    xb = cx + radius * np.cos(ang)
+    yb = cy + radius * np.sin(ang)
+    tris = []
+    for i in range(segments):
+        j = nxt[i]
+        a0 = (xb[i], yb[i], z0)
+        b0 = (xb[j], yb[j], z0)
+        a1 = (xb[i], yb[i], z1)
+        b1 = (xb[j], yb[j], z1)
+        cb = (cx, cy, z0)
+        ct = (cx, cy, z1)
+        tris.append([a0, b1, b0])  # side
+        tris.append([a0, a1, b1])
+        tris.append([cb, b0, a0])  # bottom cap (outward -z)
+        tris.append([ct, a1, b1])  # top cap
+    return np.asarray(tris, dtype=np.float32)

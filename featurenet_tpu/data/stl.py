@@ -1,0 +1,107 @@
+"""First-party STL (stereolithography) mesh reader/writer.
+
+The reference pipeline starts from STL triangle soups (reference:
+``data/voxelize.py`` — see SURVEY.md §2 C2; the mount was empty at survey time
+so the citation is to the survey's reconstruction). No third-party mesh library
+is used: binary STL is a fixed-layout record format (80-byte header, uint32
+triangle count, then ``count`` 50-byte records of ``normal(3f) v0(3f) v1(3f)
+v2(3f) attr(u16)``), and ASCII STL is a trivial keyword grammar. Both parse to
+a single ``float32 [n, 3, 3]`` vertex array (triangle-major, vertex-minor).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+_BINARY_HEADER_BYTES = 80
+_RECORD_BYTES = 50  # 12 float32 + uint16 attribute
+
+# Structured dtype matching one binary-STL triangle record.
+_RECORD_DTYPE = np.dtype(
+    [
+        ("normal", "<f4", (3,)),
+        ("verts", "<f4", (3, 3)),
+        ("attr", "<u2"),
+    ]
+)
+
+
+def _is_binary_stl(path: str) -> bool:
+    """Decide binary vs ASCII by record arithmetic, not by the 'solid' prefix.
+
+    Many binary exporters write headers that begin with ``solid``, so the only
+    reliable test is whether the file size matches the binary layout.
+    """
+    size = os.path.getsize(path)
+    if size < _BINARY_HEADER_BYTES + 4:
+        return False
+    with open(path, "rb") as f:
+        f.seek(_BINARY_HEADER_BYTES)
+        (count,) = struct.unpack("<I", f.read(4))
+    return size == _BINARY_HEADER_BYTES + 4 + count * _RECORD_BYTES
+
+
+def load_stl(path: str) -> np.ndarray:
+    """Load an STL file (binary or ASCII) into a ``float32 [n, 3, 3]`` array.
+
+    Axis layout: ``[triangle, vertex, xyz]``. Facet normals are discarded —
+    the voxelizer derives geometry from vertices alone.
+    """
+    if _is_binary_stl(path):
+        return _load_binary(path)
+    return _load_ascii(path)
+
+
+def _load_binary(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        f.seek(_BINARY_HEADER_BYTES)
+        (count,) = struct.unpack("<I", f.read(4))
+        records = np.fromfile(f, dtype=_RECORD_DTYPE, count=count)
+    if records.shape[0] != count:
+        raise ValueError(
+            f"truncated binary STL: header claims {count} triangles, "
+            f"found {records.shape[0]}"
+        )
+    return np.ascontiguousarray(records["verts"], dtype=np.float32)
+
+
+def _load_ascii(path: str) -> np.ndarray:
+    verts: list[float] = []
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 4 and parts[0] == "vertex":
+                verts.extend((float(parts[1]), float(parts[2]), float(parts[3])))
+    arr = np.asarray(verts, dtype=np.float32)
+    if arr.size == 0 or arr.size % 9 != 0:
+        # A binary file whose size doesn't match its record count also lands
+        # here (it fails the binary layout check); name both possibilities.
+        raise ValueError(
+            f"malformed STL {path!r}: not a valid binary layout (size/record "
+            "mismatch — possibly truncated) and not parseable as ASCII"
+        )
+    return arr.reshape(-1, 3, 3)
+
+
+def save_stl(path: str, triangles: np.ndarray, name: str = "featurenet") -> None:
+    """Write ``float32 [n, 3, 3]`` triangles as binary STL (normals recomputed)."""
+    tris = np.asarray(triangles, dtype=np.float32)
+    if tris.ndim != 3 or tris.shape[1:] != (3, 3):
+        raise ValueError(f"expected [n, 3, 3] triangles, got {tris.shape}")
+    e1 = tris[:, 1] - tris[:, 0]
+    e2 = tris[:, 2] - tris[:, 0]
+    normals = np.cross(e1, e2)
+    lens = np.linalg.norm(normals, axis=1, keepdims=True)
+    normals = np.where(lens > 0, normals / np.maximum(lens, 1e-30), 0.0)
+
+    records = np.zeros(tris.shape[0], dtype=_RECORD_DTYPE)
+    records["normal"] = normals.astype(np.float32)
+    records["verts"] = tris
+    header = name.encode()[: _BINARY_HEADER_BYTES].ljust(_BINARY_HEADER_BYTES, b"\0")
+    with open(path, "wb") as f:
+        f.write(header)
+        f.write(struct.pack("<I", tris.shape[0]))
+        records.tofile(f)

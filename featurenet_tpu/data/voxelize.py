@@ -1,0 +1,197 @@
+"""STL→voxel rasterization (reference capability: ``data/voxelize.py``, SURVEY.md §2 C2).
+
+Design: first-party, vectorized numpy — no mesh library, no external ``binvox``
+binary (the reference leaned on one or the other; see SURVEY.md §2's
+native-component ledger). Pipeline:
+
+1. ``normalize_mesh`` — center the triangle soup and uniformly scale it into
+   the unit cube with a configurable margin (so a part voxelized at any
+   resolution lands on the same relative geometry; scale/translate invariance
+   is a unit-tested contract, SURVEY.md §4).
+2. Surface rasterization — every triangle is covered with a dense barycentric
+   sample grid whose pitch is < half a voxel, so no voxel the surface passes
+   through is missed; samples are scatter-marked into the grid. This is
+   conservative-by-sampling rather than exact SAT; the optional native C++
+   path (``featurenet_tpu.native``) does exact triangle-box tests when built.
+3. Solid fill — parity ray casting: one vertical ray per (x, y) voxel-center
+   column, crossings accumulated per triangle and reduced with a z-cumsum
+   parity. A voxel is solid iff its *center* is inside the watertight mesh —
+   the exact occupancy semantic the classifier trains on, with no half-voxel
+   surface bias. (An exterior flood fill is kept as a fallback for meshes
+   that are not parity-clean.)
+
+The output is a ``bool [R, R, R]`` occupancy grid, index order ``[x, y, z]``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def normalize_mesh(triangles: np.ndarray, margin: float = 0.05) -> np.ndarray:
+    """Center + uniformly scale triangles into [margin, 1-margin]³.
+
+    Uniform (isotropic) scaling preserves aspect ratio — a long part stays
+    long. The margin keeps the surface off the grid boundary so the exterior
+    flood fill always has a connected outside region.
+    """
+    tris = np.asarray(triangles, dtype=np.float32)
+    lo = tris.reshape(-1, 3).min(axis=0)
+    hi = tris.reshape(-1, 3).max(axis=0)
+    center = (lo + hi) / 2.0
+    extent = float((hi - lo).max())
+    if extent <= 0:
+        raise ValueError("degenerate mesh: zero spatial extent")
+    scale = (1.0 - 2.0 * margin) / extent
+    return (tris - center) * scale + 0.5
+
+
+def _rasterize_surface(tris: np.ndarray, resolution: int) -> np.ndarray:
+    """Mark every voxel touched by a dense point sampling of each triangle."""
+    R = resolution
+    grid = np.zeros((R, R, R), dtype=bool)
+    # Work in voxel coordinates: voxel i spans [i, i+1).
+    v = tris * R
+    # Per-triangle sample density from the longest edge, pitch < 0.5 voxel.
+    e01 = np.linalg.norm(v[:, 1] - v[:, 0], axis=1)
+    e02 = np.linalg.norm(v[:, 2] - v[:, 0], axis=1)
+    e12 = np.linalg.norm(v[:, 2] - v[:, 1], axis=1)
+    max_edge = np.maximum(np.maximum(e01, e02), e12)
+    n_sub = np.clip(np.ceil(max_edge * 2.0).astype(np.int64), 1, 4096)
+
+    # Group triangles by subdivision count so each group is one vectorized op.
+    for n in np.unique(n_sub):
+        sel = v[n_sub == n]  # [t, 3, 3]
+        # Barycentric lattice: (i/n, j/n) with i+j<=n, at sub-half-voxel pitch.
+        i, j = np.meshgrid(np.arange(n + 1), np.arange(n + 1), indexing="ij")
+        keep = (i + j) <= n
+        a = (i[keep] / n).astype(np.float32)
+        b = (j[keep] / n).astype(np.float32)
+        c = 1.0 - a - b
+        # points[t, s, 3] = a*v0 + b*v1 + c*v2
+        pts = (
+            a[None, :, None] * sel[:, None, 0]
+            + b[None, :, None] * sel[:, None, 1]
+            + c[None, :, None] * sel[:, None, 2]
+        ).reshape(-1, 3)
+        idx = np.clip(np.floor(pts).astype(np.int64), 0, R - 1)
+        grid[idx[:, 0], idx[:, 1], idx[:, 2]] = True
+    return grid
+
+
+def _voxelize_parity(tris: np.ndarray, resolution: int) -> np.ndarray:
+    """Center-inside solid voxelization by vertical-ray parity counting.
+
+    For each triangle, find the (x, y) voxel-center rays piercing its xy
+    projection, compute the z of the piercing point, and toggle every voxel
+    center above it; a cumulative parity along z then yields inside/outside.
+    Rays are jittered by a sub-voxel epsilon so shared triangle edges don't
+    double-count. Exact (to fp32) for watertight meshes.
+    """
+    R = resolution
+    v = np.asarray(tris, dtype=np.float64) * R
+    toggles = np.zeros((R, R, R + 1), dtype=np.int64)
+    # Incommensurate jitter keeps rays off shared edges/vertices.
+    ex, ey = 7.3e-7, 3.1e-7
+    for tri in v:
+        (x0, y0, z0), (x1, y1, z1), (x2, y2, z2) = tri
+        det = (y1 - y2) * (x0 - x2) + (x2 - x1) * (y0 - y2)
+        if abs(det) < 1e-12:
+            continue  # degenerate or vertical: no xy area, no crossing
+        ix_lo = max(0, int(np.ceil(min(x0, x1, x2) - 0.5 - ex)))
+        ix_hi = min(R - 1, int(np.floor(max(x0, x1, x2) - 0.5 - ex)))
+        iy_lo = max(0, int(np.ceil(min(y0, y1, y2) - 0.5 - ey)))
+        iy_hi = min(R - 1, int(np.floor(max(y0, y1, y2) - 0.5 - ey)))
+        if ix_lo > ix_hi or iy_lo > iy_hi:
+            continue
+        px = np.arange(ix_lo, ix_hi + 1, dtype=np.float64) + 0.5 + ex
+        py = np.arange(iy_lo, iy_hi + 1, dtype=np.float64) + 0.5 + ey
+        PX, PY = np.meshgrid(px, py, indexing="ij")
+        a = ((y1 - y2) * (PX - x2) + (x2 - x1) * (PY - y2)) / det
+        b = ((y2 - y0) * (PX - x2) + (x0 - x2) * (PY - y2)) / det
+        c = 1.0 - a - b
+        hit = (a >= 0) & (b >= 0) & (c >= 0)
+        if not hit.any():
+            continue
+        zstar = a * z0 + b * z1 + c * z2
+        # First voxel-center index strictly above the crossing.
+        k = np.ceil(zstar - 0.5).astype(np.int64)
+        ii, jj = np.nonzero(hit)
+        kk = np.clip(k[hit], 0, R)  # k == R toggles nothing (virtual layer)
+        np.add.at(toggles, (ii + ix_lo, jj + iy_lo, kk), 1)
+    inside = (np.cumsum(toggles[:, :, :R], axis=2) % 2).astype(bool)
+    return inside
+
+
+def _fill_interior(surface: np.ndarray) -> np.ndarray:
+    """Exterior flood fill by iterative dilation, then complement.
+
+    Vectorized frontier BFS: the exterior region grows from all six grid faces
+    through empty voxels; everything never reached (surface + enclosed volume)
+    is solid. Runs in O(R) dilation sweeps, each a cheap boolean shift.
+    """
+    R = surface.shape[0]
+    empty = ~surface
+    exterior = np.zeros_like(surface)
+    for axis in range(3):
+        face = [slice(None)] * 3
+        face[axis] = 0
+        exterior[tuple(face)] = empty[tuple(face)]
+        face[axis] = R - 1
+        exterior[tuple(face)] = empty[tuple(face)]
+    while True:
+        grown = exterior.copy()
+        grown[1:, :, :] |= exterior[:-1, :, :]
+        grown[:-1, :, :] |= exterior[1:, :, :]
+        grown[:, 1:, :] |= exterior[:, :-1, :]
+        grown[:, :-1, :] |= exterior[:, 1:, :]
+        grown[:, :, 1:] |= exterior[:, :, :-1]
+        grown[:, :, :-1] |= exterior[:, :, 1:]
+        grown &= empty
+        if (grown == exterior).all():
+            break
+        exterior = grown
+    return ~exterior
+
+
+def voxelize(
+    triangles: np.ndarray,
+    resolution: int = 64,
+    fill: bool = True,
+    normalize: bool = True,
+    margin: float = 0.05,
+    backend: str = "auto",
+    fill_method: str = "parity",
+) -> np.ndarray:
+    """Voxelize a triangle soup to a ``bool [R, R, R]`` occupancy grid.
+
+    Args:
+      triangles: ``[n, 3, 3]`` vertex array (e.g. from ``load_stl``).
+      resolution: grid edge length R (reference supports 16/32/64; 128 stretch).
+      fill: if True, return the center-inside solid (parity ray casting);
+        if False, return the conservative surface shell (sampling rasterizer).
+        The two use different semantics on purpose: the solid is unbiased for
+        training occupancy grids, the shell is a superset of surface voxels.
+      normalize: run ``normalize_mesh`` first (disable if already in [0,1]³).
+      margin: normalization margin (fraction of the unit cube per side).
+      backend: "auto" | "native" | "numpy". "auto" uses the C++ rasterizer if
+        the shared library is built, else numpy. "native" requires it.
+      fill_method: "parity" (exact, watertight meshes) or "flood" (surface
+        rasterize + exterior flood fill — conservative, tolerates small holes).
+    """
+    tris = np.asarray(triangles, dtype=np.float32)
+    if normalize:
+        tris = normalize_mesh(tris, margin=margin)
+    if backend != "numpy":
+        try:
+            from featurenet_tpu.native import voxelize_native
+
+            return voxelize_native(tris, resolution, fill)
+        except Exception:
+            if backend == "native":
+                raise
+    if not fill:
+        return _rasterize_surface(tris, resolution)
+    if fill_method == "flood":
+        return _fill_interior(_rasterize_surface(tris, resolution))
+    return _voxelize_parity(tris, resolution)

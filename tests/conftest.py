@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX imports.
+
+This is the TPU-native analog of a fake distributed backend (SURVEY.md §4):
+``--xla_force_host_platform_device_count=8`` gives pmap/shard_map/pjit eight
+real (CPU) devices, so collective correctness (grad psum parity, halo
+exchange, BN sync) runs in CI with no TPU attached.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The env var alone doesn't displace out-of-tree TPU plugins (the "axon"
+# platform registers regardless); the config update before first backend
+# initialization does.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

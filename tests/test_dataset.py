@@ -1,0 +1,77 @@
+"""Input-pipeline tests: prefetch termination, errors, determinism, sharding."""
+
+import numpy as np
+import pytest
+
+from featurenet_tpu.data import SyntheticVoxelDataset, prefetch_to_device
+
+
+def test_finite_iterator_terminates():
+    batches = [{"x": np.full((2,), i)} for i in range(5)]
+    got = list(prefetch_to_device(iter(batches)))
+    assert len(got) == 5
+    np.testing.assert_array_equal(got[3]["x"], batches[3]["x"])
+
+
+def test_producer_exception_propagates():
+    def bad_gen():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("boom")
+
+    it = prefetch_to_device(bad_gen())
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_multiworker_deterministic():
+    def take(n, workers):
+        ds = SyntheticVoxelDataset(resolution=16, global_batch=4, seed=11)
+        it = prefetch_to_device(ds, num_workers=workers)
+        return [next(it)["label"] for _ in range(n)]
+
+    a = take(6, 3)
+    b = take(6, 3)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_multiworker_interleave_matches_worker_streams():
+    # Ticket residue classes: batch k comes from worker k % W's stream.
+    ds = SyntheticVoxelDataset(resolution=16, global_batch=4, seed=5)
+    W = 2
+    it = prefetch_to_device(ds, num_workers=W)
+    merged = [next(it)["voxels"] for _ in range(4)]
+    # Batch k comes from worker (k % W)'s independent stream.
+    s0 = next(ds.worker_iter(0, W))
+    s1 = next(ds.worker_iter(1, W))
+    np.testing.assert_array_equal(merged[0], s0["voxels"])
+    np.testing.assert_array_equal(merged[1], s1["voxels"])
+
+
+def test_host_sharding_decorrelated():
+    a = next(iter(SyntheticVoxelDataset(resolution=16, global_batch=8, num_hosts=2, host_id=0, seed=3)))
+    b = next(iter(SyntheticVoxelDataset(resolution=16, global_batch=8, num_hosts=2, host_id=1, seed=3)))
+    assert a["voxels"].shape[0] == 4
+    assert not np.array_equal(a["voxels"], b["voxels"])
+
+
+def test_device_put_with_sharding():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    ds = SyntheticVoxelDataset(resolution=16, global_batch=8, seed=0)
+    it = prefetch_to_device(
+        ds,
+        sharding={"voxels": NamedSharding(mesh, P("data")),
+                  "label": sharding,
+                  "seg": sharding},
+    )
+    batch = next(it)
+    shards = batch["voxels"].addressable_shards
+    assert len(shards) == 4
+    assert shards[0].data.shape == (2, 16, 16, 16, 1)
+    assert batch["label"].addressable_shards[0].data.shape == (2,)

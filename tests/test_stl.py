@@ -1,0 +1,47 @@
+"""STL reader/writer unit tests (SURVEY.md §4: parser on hand-built meshes)."""
+
+import numpy as np
+
+from featurenet_tpu.data import load_stl, save_stl
+from featurenet_tpu.data.mesh_primitives import mesh_box, mesh_cylinder
+
+
+def test_binary_roundtrip(tmp_path):
+    tris = mesh_box()
+    p = tmp_path / "box.stl"
+    save_stl(str(p), tris)
+    back = load_stl(str(p))
+    np.testing.assert_allclose(back, tris, rtol=0, atol=0)
+
+
+def test_binary_detection_solid_header(tmp_path):
+    # Binary files whose header starts with 'solid' must still parse as binary.
+    tris = mesh_box()
+    p = tmp_path / "tricky.stl"
+    save_stl(str(p), tris, name="solid looking header")
+    back = load_stl(str(p))
+    assert back.shape == (12, 3, 3)
+
+
+def test_ascii_parse(tmp_path):
+    tris = np.array(
+        [[[0, 0, 0], [1, 0, 0], [0, 1, 0]]], dtype=np.float32
+    )
+    lines = ["solid t"]
+    for tri in tris:
+        lines.append("facet normal 0 0 1")
+        lines.append("outer loop")
+        for v in tri:
+            lines.append(f"vertex {v[0]} {v[1]} {v[2]}")
+        lines.append("endloop")
+        lines.append("endfacet")
+    lines.append("endsolid t")
+    p = tmp_path / "tri.stl"
+    p.write_text("\n".join(lines))
+    back = load_stl(str(p))
+    np.testing.assert_allclose(back, tris)
+
+
+def test_cylinder_mesh_shape():
+    tris = mesh_cylinder(segments=16)
+    assert tris.shape == (64, 3, 3)

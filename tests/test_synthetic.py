@@ -1,0 +1,67 @@
+"""Synthetic 24-class generator tests: determinism, class coverage, geometry."""
+
+import numpy as np
+
+from featurenet_tpu.data import (
+    CLASS_NAMES,
+    NUM_CLASSES,
+    generate_batch,
+    generate_sample,
+)
+from featurenet_tpu.data.synthetic import stock_mask
+
+
+def test_24_classes():
+    assert NUM_CLASSES == 24
+    assert len(set(CLASS_NAMES)) == 24
+
+
+def test_every_class_carves_material(rng):
+    # Each feature must remove a nontrivial volume from the stock but leave
+    # a nontrivial part behind.
+    R = 32
+    stock = stock_mask(R)
+    for cls in range(NUM_CLASSES):
+        part, labels, seg = generate_sample(rng, R, label=cls, orient=False)
+        removed = int(stock.sum()) - int(part.sum())
+        assert removed > 8, f"{CLASS_NAMES[cls]} removed nothing"
+        assert part.sum() > 0.2 * stock.sum(), f"{CLASS_NAMES[cls]} ate the part"
+        assert labels[0] == cls
+        # Seg labels live exactly where material was removed from stock.
+        assert (seg == cls + 1).sum() == removed
+
+
+def test_determinism():
+    a = generate_batch(np.random.default_rng(7), 8, resolution=16)
+    b = generate_batch(np.random.default_rng(7), 8, resolution=16)
+    np.testing.assert_array_equal(a["voxels"], b["voxels"])
+    np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_batch_shapes_and_balance(rng):
+    B, R = 48, 16
+    batch = generate_batch(rng, B, resolution=R, balanced=True)
+    assert batch["voxels"].shape == (B, R, R, R, 1)
+    assert batch["voxels"].dtype == np.float32
+    assert batch["label"].shape == (B,)
+    assert batch["seg"].shape == (B, R, R, R)
+    # Balanced: first 48 samples cover each class exactly twice.
+    counts = np.bincount(batch["label"], minlength=24)
+    assert (counts == 2).all()
+
+
+def test_multi_feature_seg(rng):
+    part, labels, seg = generate_sample(rng, 32, num_features=3)
+    assert labels.shape == (3,)
+    present = set(np.unique(seg)) - {0}
+    # At least one feature's label must appear (features may overlap/occlude).
+    assert len(present) >= 1
+    assert present <= {int(l) + 1 for l in labels}
+
+
+def test_orientation_preserves_counts():
+    r1 = np.random.default_rng(3)
+    r2 = np.random.default_rng(3)
+    p_plain, _, _ = generate_sample(r1, 16, label=1, orient=False)
+    p_rot, _, _ = generate_sample(r2, 16, label=1, orient=True)
+    assert p_plain.sum() == p_rot.sum()

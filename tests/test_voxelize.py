@@ -1,0 +1,71 @@
+"""Voxelizer invariants (SURVEY.md §4): analytic occupancy, fill, invariance."""
+
+import numpy as np
+import pytest
+
+from featurenet_tpu.data import normalize_mesh, voxelize
+from featurenet_tpu.data.mesh_primitives import mesh_box, mesh_cylinder
+
+
+def _iou(a, b):
+    return (a & b).sum() / max(1, (a | b).sum())
+
+
+def test_cube_occupancy_matches_analytic():
+    # A cube normalized with margin m fills [m, 1-m]^3 exactly.
+    R, m = 16, 0.125
+    grid = voxelize(mesh_box(), resolution=R, margin=m, backend="numpy")
+    c = (np.arange(R) + 0.5) / R
+    X, Y, Z = np.meshgrid(c, c, c, indexing="ij")
+    expected = (
+        (X > m) & (X < 1 - m) & (Y > m) & (Y < 1 - m) & (Z > m) & (Z < 1 - m)
+    )
+    # Parity fill is exact center-inside occupancy for a watertight box.
+    np.testing.assert_array_equal(grid, expected)
+
+
+def test_fill_vs_shell():
+    R = 32
+    solid = voxelize(mesh_box(), resolution=R, fill=True, backend="numpy")
+    shell = voxelize(mesh_box(), resolution=R, fill=False, backend="numpy")
+    assert solid.sum() > shell.sum()
+    # Solid has interior voxels the shell doesn't touch.
+    assert (solid & ~shell).sum() > 0
+    # Flood fill (conservative) must contain the parity solid for a box.
+    flood = voxelize(
+        mesh_box(), resolution=R, fill=True, fill_method="flood", backend="numpy"
+    )
+    assert (solid & ~flood).sum() == 0
+
+
+def test_cylinder_occupancy():
+    R = 32
+    grid = voxelize(
+        mesh_cylinder(radius=0.25, z0=0.2, z1=0.8, segments=64),
+        resolution=R,
+        normalize=False,
+        backend="numpy",
+    )
+    c = (np.arange(R) + 0.5) / R
+    X, Y, Z = np.meshgrid(c, c, c, indexing="ij")
+    expected = (
+        ((X - 0.5) ** 2 + (Y - 0.5) ** 2 < 0.25**2) & (Z > 0.2) & (Z < 0.8)
+    )
+    assert _iou(grid, expected) > 0.8
+
+
+@pytest.mark.parametrize("shift,scale", [(3.0, 2.0), (-10.0, 0.1)])
+def test_normalize_invariance(shift, scale):
+    # Voxelization is invariant to rigid translation + uniform scale.
+    tris = mesh_box()
+    moved = tris * scale + shift
+    a = voxelize(tris, resolution=16, backend="numpy")
+    b = voxelize(moved, resolution=16, backend="numpy")
+    np.testing.assert_array_equal(a, b)
+
+
+def test_normalize_mesh_bounds():
+    tris = normalize_mesh(mesh_box() * 7.3 + 2.0, margin=0.1)
+    flat = tris.reshape(-1, 3)
+    assert flat.min() >= 0.1 - 1e-5
+    assert flat.max() <= 0.9 + 1e-5
