@@ -1,0 +1,107 @@
+"""Benchmark: 64³-voxel training throughput, samples/sec/chip (BASELINE.json).
+
+Runs the pod64 flagship config's compiled train step on all visible devices
+(one real TPU chip under the driver) and prints ONE JSON line:
+
+    {"metric": "...", "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+``vs_baseline``: BASELINE.json publishes no reference throughput (the paper
+reports none — SURVEY.md §6); the north-star denominator is "single-V100
+samples/sec" which cannot be measured here. We use a documented, conservative
+stand-in: 330 samples/sec for FeatureNet-64³ on a V100 (fp32 cuDNN, batch 96 —
+derived in BASELINE.md; flagged as estimated). vs_baseline = measured / 330.
+
+Method: jit the full train step (fwd+bwd+optimizer+BN) at global batch 96,
+warm up, then *slope timing*: wall (1 step + loss transfer) and (N+1 steps +
+loss transfer); per-step time = (t_long - t_short)/N. The final scalar
+transfer is the sync point — on this environment's tunneled TPU backend,
+``block_until_ready`` returns before device execution completes, so only a
+device→host readback is an honest wall; the slope subtracts the constant
+round-trip latency from the measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+V100_SAMPLES_PER_SEC_EST = 330.0  # documented estimate, see BASELINE.md
+BATCH = 96
+WARMUP, MEASURE = 5, 20
+
+
+def main() -> None:
+    import jax
+
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.models import FeatureNet
+    from featurenet_tpu.parallel.mesh import (
+        batch_shardings,
+        make_mesh,
+        replicated,
+        state_shardings,
+    )
+    from featurenet_tpu.train.state import create_state
+    from featurenet_tpu.train.steps import make_optimizer, make_train_step
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh()  # all devices on 'data'
+    cfg = get_config("pod64")
+    # Per-chip batch stays BATCH regardless of chip count (weak scaling).
+    global_batch = BATCH * mesh.shape["data"]
+
+    model = FeatureNet(arch=cfg.arch)
+    tx = make_optimizer(cfg)
+
+    def init_fn(rng):
+        import jax.numpy as jnp
+
+        sample = jnp.zeros((global_batch, 64, 64, 64, 1), jnp.float32)
+        return create_state(model, tx, sample, rng)
+
+    abstract = jax.eval_shape(init_fn, jax.random.key(0))
+    st_sh = state_shardings(abstract, mesh)
+    state = jax.jit(init_fn, out_shardings=st_sh)(jax.random.key(0))
+
+    b_sh = batch_shardings(mesh)
+    step = jax.jit(
+        make_train_step(model, "classify"),
+        in_shardings=(st_sh, b_sh, replicated(mesh)),
+        out_shardings=(st_sh, replicated(mesh)),
+        donate_argnums=(0,),
+    )
+
+    host = generate_batch(np.random.default_rng(0), global_batch, 64)
+    batch = jax.device_put(host, b_sh)
+    rng = jax.device_put(jax.random.key(1), replicated(mesh))
+
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])  # drain the pipe
+
+    def walled(k: int) -> float:
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, metrics = step(state, batch, rng)
+        float(metrics["loss"])  # device→host readback = honest sync
+        return time.perf_counter() - t0
+
+    t_short = walled(1)
+    t_long = walled(1 + MEASURE)
+    per_step = (t_long - t_short) / MEASURE
+    sps = global_batch / per_step
+    sps_chip = sps / n_chips
+    print(json.dumps({
+        "metric": "featurenet64_train_throughput",
+        "value": round(sps_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_chip / V100_SAMPLES_PER_SEC_EST, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Command-line entry point (reference parity: ``python train.py …`` flags,
+SURVEY.md §2 C8 — argparse over resolution/batch/lr/epochs/data/world-size).
+
+Usage:
+    python -m featurenet_tpu.cli train --config pod64 [--overrides…]
+    python -m featurenet_tpu.cli eval  --config pod64 --checkpoint-dir D
+    python -m featurenet_tpu.cli bench
+
+Multi-host: pass ``--distributed`` to call ``jax.distributed.initialize()``
+before any device query (the TPU-native replacement for torchrun + NCCL
+rendezvous; coordinator/rank discovery comes from the TPU environment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+
+
+def _add_override_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", default="pod64")
+    p.add_argument("--resolution", type=int)
+    p.add_argument("--global-batch", type=int)
+    p.add_argument("--peak-lr", type=float)
+    p.add_argument("--total-steps", type=int)
+    p.add_argument("--seed", type=int)
+    p.add_argument("--checkpoint-dir")
+    p.add_argument("--mesh-model", type=int)
+    p.add_argument("--data-workers", type=int)
+
+
+def _overrides(args) -> dict:
+    keys = [
+        "resolution", "global_batch", "peak_lr", "total_steps", "seed",
+        "checkpoint_dir", "mesh_model", "data_workers",
+    ]
+    return {k: getattr(args, k) for k in keys if getattr(args, k) is not None}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="featurenet_tpu")
+    parser.add_argument("--distributed", action="store_true",
+                        help="multi-host: jax.distributed.initialize() first")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    _add_override_flags(sub.add_parser("train"))
+    _add_override_flags(sub.add_parser("eval"))
+    sub.add_parser("bench")
+    args = parser.parse_args(argv)
+
+    if args.distributed:
+        import jax
+
+        jax.distributed.initialize()
+
+    if args.cmd == "bench":
+        import bench
+
+        bench.main()
+        return
+
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.train.loop import Trainer
+
+    cfg = get_config(args.config, **_overrides(args))
+    print(json.dumps({"config": dataclasses.asdict(cfg)}, default=str))
+    trainer = Trainer(cfg)
+    if args.cmd == "train":
+        trainer.run()
+    else:
+        if trainer.ckpt is None or trainer.ckpt.latest_step() is None:
+            raise SystemExit(
+                "eval: no checkpoint found — pass --checkpoint-dir pointing "
+                "at a trained run (evaluating random weights is never useful)"
+            )
+        trainer.resume_if_available()
+        print(json.dumps({"eval": trainer.evaluate()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""Frozen run configs — the five BASELINE.json configs as named presets.
+
+The reference drove everything through argparse flags on ``train.py``
+(SURVEY.md §2 C8). Here the single source of truth is a frozen dataclass:
+hashable (so it can parameterize jit caches), serializable into checkpoints,
+and overridable field-by-field from the CLI (``featurenet_tpu.cli``).
+
+Presets map 1:1 onto BASELINE.json's config ladder:
+  smoke16 — 16³ single-feature, tiny net, CPU smoke            (config 1)
+  xla32   — 32³, full FeatureNet stack, single-chip XLA        (config 2)
+  pod64   — 64³ published config, data-parallel over the mesh  (config 3)
+  seg64   — 64³ multi-feature per-voxel segmentation           (config 4)
+  abc128  — 128³ deeper net, pod-scale, spatial partitioning   (config 5)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from featurenet_tpu.models.featurenet import (
+    FeatureNetArch,
+    deep_arch,
+    tiny_arch,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    name: str = "pod64"
+    # Task: "classify" (24-way logits) or "segment" (per-voxel dense logits).
+    task: str = "classify"
+
+    # Data.
+    resolution: int = 64
+    global_batch: int = 96
+    num_features: int = 1  # features carved per part (>1 for segmentation)
+    eval_batches: int = 8
+    data_workers: int = 2
+    seed: int = 0
+
+    # Model.
+    arch: FeatureNetArch = dataclasses.field(default_factory=FeatureNetArch)
+    seg_features: tuple[int, ...] = (32, 64, 128)
+
+    # Optimization.
+    optimizer: str = "adamw"
+    peak_lr: float = 1e-3
+    weight_decay: float = 1e-4
+    warmup_steps: int = 100
+    total_steps: int = 3000
+    label_smoothing: float = 0.0
+
+    # Parallelism (mesh axis sizes; None = use all available devices on data).
+    mesh_data: Optional[int] = None
+    mesh_model: int = 1
+    # Shard the voxel depth axis over 'model' (XLA conv halo exchange) — the
+    # 128³-grids-outgrow-HBM path. Needs mesh_model > 1 to have any effect.
+    spatial: bool = False
+
+    # Logging / checkpointing.
+    log_every: int = 50
+    eval_every: int = 500
+    checkpoint_every: int = 500
+    checkpoint_dir: Optional[str] = None
+    keep_checkpoints: int = 3
+
+    def validate(self) -> "Config":
+        if self.task not in ("classify", "segment"):
+            raise ValueError(f"unknown task {self.task!r}")
+        if self.resolution % 2:
+            raise ValueError("resolution must be even")
+        if self.task == "segment":
+            down = 2 ** len(self.seg_features)
+            if self.resolution % down:
+                raise ValueError(
+                    f"segment task: resolution {self.resolution} must be "
+                    f"divisible by 2**len(seg_features) = {down}"
+                )
+        return self
+
+
+def smoke16() -> Config:
+    return Config(
+        name="smoke16",
+        resolution=16,
+        global_batch=32,
+        arch=tiny_arch(),
+        peak_lr=3e-3,
+        warmup_steps=10,
+        total_steps=200,
+        log_every=20,
+        eval_every=100,
+        checkpoint_every=100,
+        eval_batches=2,
+    ).validate()
+
+
+def xla32() -> Config:
+    return Config(
+        name="xla32",
+        resolution=32,
+        global_batch=64,
+        total_steps=2000,
+    ).validate()
+
+
+def pod64() -> Config:
+    return Config(
+        name="pod64",
+        resolution=64,
+        global_batch=96,
+        total_steps=5000,
+    ).validate()
+
+
+def seg64() -> Config:
+    return Config(
+        name="seg64",
+        task="segment",
+        resolution=64,
+        global_batch=32,
+        num_features=3,
+        total_steps=5000,
+        peak_lr=5e-4,
+    ).validate()
+
+
+def abc128() -> Config:
+    return Config(
+        name="abc128",
+        resolution=128,
+        global_batch=32,
+        arch=deep_arch(),
+        total_steps=8000,
+        peak_lr=5e-4,
+        # 128³ grids: shard depth over 'model' when mesh_model > 1 so deep
+        # nets fit per-chip HBM (BASELINE config 5).
+        spatial=True,
+        mesh_model=2,
+    ).validate()
+
+
+PRESETS = {
+    "smoke16": smoke16,
+    "xla32": xla32,
+    "pod64": pod64,
+    "seg64": seg64,
+    "abc128": abc128,
+}
+
+
+def get_config(name: str, **overrides) -> Config:
+    """Look up a preset and apply field overrides."""
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    cfg = PRESETS[name]()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides).validate()
+    return cfg

@@ -83,6 +83,25 @@ class _WorkerDone:
     pass
 
 
+def put_batch(batch, sharding):
+    """Place a host-local batch under a (possibly multi-host) sharding.
+
+    Single-process: plain ``device_put``. Multi-process: each host holds only
+    its slice of the global batch, so the global array is assembled from
+    process-local shards (``make_array_from_process_local_data``) — the
+    device_put path would wrongly treat the local slice as the global array.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.make_array_from_process_local_data(s, x),
+        batch,
+        sharding,
+    )
+
+
 def prefetch_to_device(
     source,
     sharding=None,
@@ -174,7 +193,7 @@ def prefetch_to_device(
                 raise item
             else:
                 if sharding is not None:
-                    item = jax.device_put(item, sharding)
+                    item = put_batch(item, sharding)
                 yield item
             nxt += 1
     finally:

@@ -1,0 +1,6 @@
+"""Flax model families: voxel classifier and per-voxel segmenter."""
+
+from featurenet_tpu.models.featurenet import FeatureNet, FeatureNetArch
+from featurenet_tpu.models.segmenter import FeatureNetSegmenter
+
+__all__ = ["FeatureNet", "FeatureNetArch", "FeatureNetSegmenter"]

@@ -1,0 +1,69 @@
+"""Per-voxel segmentation head (BASELINE.json config 4: ``seg64``).
+
+The reference repo has no segmentation model — this is a *new capability*
+listed in the driver's config ladder ("64^3 multi-feature per-voxel
+segmentation head (dense output)", BASELINE.json:10). Design: a small
+U-Net-shaped encoder/decoder over the same ConvBNRelu blocks as the
+classifier. Encoder downsamples by stride-2 convs (not pools — the decoder
+mirrors them with transposed convs), skip connections concatenate at equal
+resolution, and the head emits ``num_classes + 1`` per-voxel logits
+(class 0 = background / not-a-feature, matching
+``featurenet_tpu.data.synthetic.generate_sample``'s ``seg`` encoding).
+
+TPU notes: everything stays NDHWC/bf16 like the classifier; transposed convs
+lower to regular convs on TPU (XLA rewrites them), so the whole decoder is
+MXU work. Skip concatenation is on the channel (minor) axis — free layout-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from featurenet_tpu.data.synthetic import NUM_CLASSES
+from featurenet_tpu.models.featurenet import ConvBNRelu
+
+
+class FeatureNetSegmenter(nn.Module):
+    """Dense per-voxel classifier.
+
+    Input  ``voxels``: float ``[B, R, R, R, 1]``; R must be divisible by
+    ``2**len(features)``.
+    Output logits: fp32 ``[B, R, R, R, num_classes + 1]``.
+    """
+
+    features: Sequence[int] = (32, 64, 128)
+    num_classes: int = NUM_CLASSES
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, voxels, train: bool = False):
+        x = voxels.astype(self.dtype)
+        skips = []
+        # Encoder: each stage = refine at-res, then strided downsample.
+        for f in self.features:
+            x = ConvBNRelu(f, kernel=3, stride=1, dtype=self.dtype)(x, train)
+            skips.append(x)
+            x = ConvBNRelu(f, kernel=3, stride=2, dtype=self.dtype)(x, train)
+        # Bottleneck.
+        x = ConvBNRelu(self.features[-1] * 2, kernel=3, dtype=self.dtype)(x, train)
+        # Decoder: transposed-conv upsample, concat skip, refine.
+        for f, skip in zip(reversed(self.features), reversed(skips)):
+            x = nn.ConvTranspose(
+                f,
+                kernel_size=(2, 2, 2),
+                strides=(2, 2, 2),
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBNRelu(f, kernel=3, dtype=self.dtype)(x, train)
+        x = nn.Conv(
+            self.num_classes + 1,
+            kernel_size=(1, 1, 1),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,19 @@
+"""Mesh, shardings, and distributed initialization (the NCCL/DDP replacement).
+
+The reference scaled with ``DistributedDataParallel`` over NCCL — a wrapper
+object that hooks gradient buckets and calls ring-allreduce (SURVEY.md §2 C5).
+This package contains *no* collective calls at all: parallelism is expressed
+as data placement (``jax.sharding.NamedSharding`` over a ``Mesh``), and every
+collective — gradient reduction, BatchNorm stat sync, halo exchange for
+spatially-partitioned convs — is inserted by XLA's SPMD partitioner inside
+the one compiled train step, where it can overlap with compute on ICI.
+"""
+
+from featurenet_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+    replicated,
+)
+
+__all__ = ["make_mesh", "batch_sharding", "param_shardings", "replicated"]

@@ -1,0 +1,131 @@
+"""Device mesh and sharding-rule construction.
+
+Axes:
+  ``data``  — pure data parallelism: the global batch's leading dim is split
+              here; gradients come back via an XLA-inserted reduce (the ICI
+              analog of NCCL ring-allreduce, but fused into the step).
+  ``model`` — intra-model parallelism. Two uses, composable:
+              (a) channel/tensor parallelism: output channels of large Dense/
+                  Conv kernels are sharded, so the flatten→FC matmul (the
+                  parameter bulk of FeatureNet) is computed column-parallel;
+              (b) spatial partitioning: the voxel grid's depth axis is split
+                  across ``model``; XLA emits conv halo exchanges over ICI
+                  (the TPU-native "sequence parallelism" of a 3D-CNN — there
+                  is no sequence axis, the spatial grid is the long axis;
+                  SURVEY.md §5 "long-context").
+
+Multi-host: `jax.distributed.initialize()` (call before device queries) makes
+``jax.devices()`` span hosts; the same mesh code then lays axes over
+ICI-within-slice / DCN-across-slices. ``make_mesh`` orders ``data`` as the
+outermost (slowest, DCN-friendly) axis and ``model`` innermost (ICI) for that
+reason: model-parallel collectives are latency-bound and must ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """Build a ``('data', 'model')`` mesh over the available devices.
+
+    ``data=None`` uses all devices not consumed by ``model``.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if model < 1 or n % model:
+        raise ValueError(f"model axis {model} must divide device count {n}")
+    if data is None:
+        data = n // model
+    if data * model > n:
+        raise ValueError(f"mesh {data}x{model} exceeds {n} devices")
+    grid = np.asarray(devices[: data * model]).reshape(data, model)
+    return Mesh(grid, axis_names=("data", "model"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, spatial: bool = False) -> NamedSharding:
+    """Sharding for ``[B, D, H, W, C]`` voxel batches.
+
+    Batch over ``data``; with ``spatial=True`` the depth axis is additionally
+    split over ``model`` (XLA inserts conv halo exchanges — BASELINE config 5's
+    path for 128³ grids that outgrow a chip's HBM).
+    """
+    if spatial:
+        return NamedSharding(mesh, P("data", "model"))
+    return NamedSharding(mesh, P("data"))
+
+
+def label_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("data"))
+
+
+# --- parameter sharding rules (channel tensor-parallelism) ------------------
+
+# Kernels whose output-channel axis is at least this large get sharded over
+# 'model'; smaller ones are replicated (collective latency would dominate).
+_MIN_SHARD_DIM = 64
+
+
+def _param_spec(path: tuple, x, model_axis_size: int) -> P:
+    if model_axis_size <= 1 or x.ndim == 0:
+        return P()
+    out_dim = x.shape[-1]
+    names = [getattr(k, "key", str(k)) for k in path]
+    is_kernel = names and names[-1] == "kernel"
+    if is_kernel and out_dim >= _MIN_SHARD_DIM and out_dim % model_axis_size == 0:
+        # Dense [in, out] or Conv [k,k,k,in,out]: column-parallel on 'model'.
+        return P(*([None] * (x.ndim - 1) + ["model"]))
+    return P()
+
+
+def param_shardings(params, mesh: Mesh):
+    """A pytree of ``NamedSharding`` matching ``params``.
+
+    Rule-based tensor parallelism: large kernel output channels go over
+    ``model``; everything else (biases, BN scales/stats, small kernels) is
+    replicated. With ``model=1`` this degenerates to full replication — the
+    pure-DP pod64 config.
+    """
+    msize = mesh.shape["model"]
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: NamedSharding(mesh, _param_spec(path, x, msize)),
+        params,
+    )
+
+
+def state_shardings(state, mesh: Mesh):
+    """Shardings for a full ``TrainState`` pytree (params + opt_state + …).
+
+    Optimizer moments (Adam's mu/nu) mirror the params tree structure, so the
+    same path-based rule shards them identically to their parameter — the
+    moment for a column-parallel kernel lives on the same shard as the kernel.
+    Scalars (step, schedule counts) and BN state replicate.
+    """
+    msize = mesh.shape["model"]
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: NamedSharding(mesh, _param_spec(path, x, msize)),
+        state,
+    )
+
+
+def batch_shardings(mesh: Mesh, spatial: bool = False) -> dict:
+    """Sharding dict matching ``generate_batch`` output structure."""
+    return {
+        "voxels": batch_sharding(mesh, spatial),
+        "label": NamedSharding(mesh, P("data")),
+        "seg": NamedSharding(
+            mesh, P("data", "model") if spatial else P("data")
+        ),
+    }

@@ -1,0 +1,20 @@
+"""Training: state, jitted steps, checkpointing, and the run loop.
+
+Reference parity: `train.py`'s epoch loop — forward, cross-entropy, backward,
+step, periodic eval and ``torch.save`` (SURVEY.md §3.1). Rebuilt as: one
+jit-compiled SPMD train step (loss, grads, optimizer update, BN stat update,
+and every collective fused into a single XLA executable), a host loop that
+only feeds batches and reads metrics, and Orbax for checkpoint/resume.
+"""
+
+from featurenet_tpu.train.state import TrainState, create_state
+from featurenet_tpu.train.steps import make_eval_step, make_train_step
+from featurenet_tpu.train.loop import Trainer
+
+__all__ = [
+    "TrainState",
+    "create_state",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+]

@@ -1,0 +1,191 @@
+"""Jitted train / eval steps and the loss+metric functions they share.
+
+Everything here is pure and shape-monomorphic: one train step is one XLA
+executable containing forward, backward, the optimizer update, the BN stat
+update, and — when the batch is sharded over a mesh — every collective the
+partitioner decides it needs. The host loop never sees a gradient.
+
+Reference parity (SURVEY.md §3.1 hot loop): forward → cross_entropy →
+backward → allreduce → step. Here the "allreduce" has no call site: reducing
+a mean over a ``data``-sharded batch axis *is* the gradient sync.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from featurenet_tpu.train.state import TrainState
+
+
+def classification_loss(
+    logits: jax.Array,  # [B, C] fp32
+    labels: jax.Array,  # [B] int32
+    label_smoothing: float = 0.0,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    num_classes = logits.shape[-1]
+    if label_smoothing > 0.0:
+        onehot = optax.smooth_labels(
+            jax.nn.one_hot(labels, num_classes), label_smoothing
+        )
+        loss = optax.softmax_cross_entropy(logits, onehot).mean()
+    else:
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+    acc = (jnp.argmax(logits, axis=-1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def segmentation_loss(
+    logits: jax.Array,  # [B, D, H, W, C+1] fp32
+    seg: jax.Array,  # [B, D, H, W] int32, 0 = background
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Per-voxel cross-entropy with background down-weighting.
+
+    Background dominates (a carved part is mostly stock/air), so feature
+    voxels are up-weighted to balance the gradient signal.
+    """
+    per_voxel = optax.softmax_cross_entropy_with_integer_labels(logits, seg)
+    is_fg = (seg > 0).astype(jnp.float32)
+    # Foreground voxels weighted so fg and bg contribute ~equally.
+    fg_frac = is_fg.mean()
+    w = jnp.where(seg > 0, 0.5 / jnp.maximum(fg_frac, 1e-4),
+                  0.5 / jnp.maximum(1.0 - fg_frac, 1e-4))
+    loss = (per_voxel * w).mean()
+    pred = jnp.argmax(logits, axis=-1)
+    acc = (pred == seg).mean()
+    fg_acc = jnp.where(
+        is_fg.sum() > 0, ((pred == seg) * is_fg).sum() / is_fg.sum(), 0.0
+    )
+    return loss, {"loss": loss, "accuracy": acc, "fg_accuracy": fg_acc}
+
+
+def make_train_step(
+    model,
+    task: str = "classify",
+    label_smoothing: float = 0.0,
+) -> Callable:
+    """Build the pure train-step function (jit it with shardings at call site)."""
+
+    target_key = "label" if task == "classify" else "seg"
+
+    def loss_fn(params, batch_stats, batch, dropout_rng):
+        out, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["voxels"],
+            train=True,
+            rngs={"dropout": dropout_rng},
+            mutable=["batch_stats"],
+        )
+        if task == "classify":
+            loss, metrics = classification_loss(
+                out, batch[target_key], label_smoothing
+            )
+        else:
+            loss, metrics = segmentation_loss(out, batch[target_key])
+        return loss, (mutated["batch_stats"], metrics)
+
+    def train_step(state: TrainState, batch, rng):
+        # Fold the step index in so dropout differs per step from one base key.
+        dropout_rng = jax.random.fold_in(rng, state.step)
+        grads, (new_stats, metrics) = jax.grad(loss_fn, has_aux=True)(
+            state.params, state.batch_stats, batch, dropout_rng
+        )
+        state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return state, metrics
+
+    return train_step
+
+
+def make_eval_step(model, task: str = "classify") -> Callable:
+    """Eval step returning *sums* (not means) so batches aggregate exactly.
+
+    For segmentation it also returns per-class intersection/union counts so
+    the host can compute mean IoU over the whole eval set (SURVEY.md §7.5).
+    """
+
+    def eval_step(params, batch_stats, batch):
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["voxels"],
+            train=False,
+        )
+        if task == "classify":
+            pred = jnp.argmax(logits, axis=-1)
+            correct = (pred == batch["label"]).sum()
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]
+            ).sum()
+            return {
+                "correct": correct,
+                "loss_sum": loss,
+                "count": jnp.asarray(batch["label"].shape[0], jnp.int32),
+            }
+        seg = batch["seg"]
+        pred = jnp.argmax(logits, axis=-1)
+        n_cls = logits.shape[-1]
+        pred_1h = jax.nn.one_hot(pred, n_cls, dtype=jnp.float32)
+        true_1h = jax.nn.one_hot(seg, n_cls, dtype=jnp.float32)
+        axes = tuple(range(pred_1h.ndim - 1))
+        inter = (pred_1h * true_1h).sum(axes)  # [C+1]
+        union = pred_1h.sum(axes) + true_1h.sum(axes) - inter
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, seg
+        ).sum()
+        return {
+            "correct": (pred == seg).sum(),
+            "loss_sum": loss,
+            "count": jnp.asarray(seg.size, jnp.int32),
+            "intersection": inter,
+            "union": union,
+        }
+
+    return eval_step
+
+
+def aggregate_eval(metric_list: list[dict]) -> dict[str, float]:
+    """Host-side exact aggregation of per-batch eval sums."""
+    import numpy as np
+
+    total = {}
+    for m in metric_list:
+        for k, v in m.items():
+            total[k] = total.get(k, 0) + np.asarray(v)
+    out = {
+        "accuracy": float(total["correct"] / total["count"]),
+        "loss": float(total["loss_sum"] / total["count"]),
+    }
+    if "intersection" in total:
+        union = total["union"]
+        present = union > 0  # ignore classes absent from both pred & truth
+        iou = np.where(present, total["intersection"] / np.maximum(union, 1), 0.0)
+        out["mean_iou"] = float(iou.sum() / np.maximum(present.sum(), 1))
+    return out
+
+
+def make_lr_schedule(
+    peak_lr: float, warmup_steps: int, total_steps: int
+) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=peak_lr * 0.01,
+    )
+
+
+def make_optimizer(cfg) -> optax.GradientTransformation:
+    sched = make_lr_schedule(cfg.peak_lr, cfg.warmup_steps, cfg.total_steps)
+    if cfg.optimizer == "adamw":
+        return optax.adamw(sched, weight_decay=cfg.weight_decay)
+    if cfg.optimizer == "adam":
+        return optax.adam(sched)
+    if cfg.optimizer == "sgd":
+        return optax.sgd(sched, momentum=0.9)
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")

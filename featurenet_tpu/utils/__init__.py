@@ -1,0 +1,5 @@
+"""Utilities: metric logging, timing, profiling hooks."""
+
+from featurenet_tpu.utils.logging import MetricLogger
+
+__all__ = ["MetricLogger"]

@@ -1,0 +1,83 @@
+"""Model shape/precision tests (SURVEY.md §4 unit tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from featurenet_tpu.models import FeatureNet, FeatureNetArch, FeatureNetSegmenter
+from featurenet_tpu.models.featurenet import tiny_arch
+from featurenet_tpu.train.state import param_count
+
+
+def _init_and_apply(model, x, train=False):
+    variables = model.init(
+        {"params": jax.random.key(0)}, x, train=False
+    )
+    rngs = {"dropout": jax.random.key(1)} if train else None
+    out = model.apply(variables, x, train=train, rngs=rngs,
+                      mutable=["batch_stats"] if train else False)
+    return variables, out
+
+
+@pytest.mark.parametrize("res", [16, 32, 64])
+def test_classifier_output_shape(res):
+    """Contract (SURVEY.md §3.3): R³ grid in → [B, 24] logits out, any R."""
+    model = FeatureNet(arch=tiny_arch())
+    x = jnp.zeros((2, res, res, res, 1), jnp.float32)
+    _, logits = _init_and_apply(model, x)
+    assert logits.shape == (2, 24)
+    assert logits.dtype == jnp.float32
+
+
+def test_classifier_param_count_in_contract_range():
+    """The published-shape arch must land in the ~1–5M param band (SURVEY §3.3)."""
+    model = FeatureNet()  # default paper-shape arch at 64³
+    x = jnp.zeros((1, 64, 64, 64, 1), jnp.float32)
+    variables = model.init({"params": jax.random.key(0)}, x, train=False)
+    n = param_count(variables["params"])
+    assert 1_000_000 <= n <= 8_000_000, n
+
+
+def test_classifier_train_mode_updates_batch_stats():
+    model = FeatureNet(arch=tiny_arch())
+    x = jnp.asarray(np.random.default_rng(0).random((4, 16, 16, 16, 1)),
+                    jnp.float32)
+    variables, (logits, mutated) = _init_and_apply(model, x, train=True)
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+def test_classifier_params_and_bn_are_fp32():
+    model = FeatureNet(arch=tiny_arch())
+    x = jnp.zeros((1, 16, 16, 16, 1), jnp.float32)
+    variables = model.init({"params": jax.random.key(0)}, x, train=False)
+    for leaf in jax.tree_util.tree_leaves(variables):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+
+
+def test_bf16_vs_fp32_logit_drift_bounded():
+    """bf16 compute must stay close to an fp32 reference forward (SURVEY §4)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((2, 16, 16, 16, 1)), jnp.float32)
+    arch = tiny_arch()
+    m16 = FeatureNet(arch=arch, dtype=jnp.bfloat16)
+    m32 = FeatureNet(arch=arch, dtype=jnp.float32)
+    variables = m16.init({"params": jax.random.key(0)}, x, train=False)
+    l16 = m16.apply(variables, x, train=False)
+    l32 = m32.apply(variables, x, train=False)
+    assert np.max(np.abs(np.asarray(l16) - np.asarray(l32))) < 0.15
+
+
+def test_segmenter_output_shape():
+    model = FeatureNetSegmenter(features=(8, 16))
+    x = jnp.zeros((2, 16, 16, 16, 1), jnp.float32)
+    _, logits = _init_and_apply(model, x)
+    assert logits.shape == (2, 16, 16, 16, 25)
+    assert logits.dtype == jnp.float32
+
+
+def test_custom_arch_validation():
+    with pytest.raises(ValueError):
+        FeatureNetArch(features=(32,), kernels=(3, 3))
