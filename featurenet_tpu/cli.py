@@ -5,6 +5,8 @@ Usage:
     python -m featurenet_tpu.cli train --config pod64 [--overrides…]
     python -m featurenet_tpu.cli eval  --config pod64 --checkpoint-dir D
     python -m featurenet_tpu.cli bench
+    python -m featurenet_tpu.cli export-data --out D [--per-class N]
+    python -m featurenet_tpu.cli build-cache --stl-root S --out D
 
 Multi-host: pass ``--distributed`` to call ``jax.distributed.initialize()``
 before any device query (the TPU-native replacement for torchrun + NCCL
@@ -28,12 +30,17 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-dir")
     p.add_argument("--mesh-model", type=int)
     p.add_argument("--data-workers", type=int)
+    p.add_argument("--data-cache", help="offline npz cache dir (see export-data)")
+    p.add_argument("--profile-dir", help="capture an XProf trace here")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="jax_debug_nans: fail fast on the op producing a NaN")
 
 
 def _overrides(args) -> dict:
     keys = [
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
-        "checkpoint_dir", "mesh_model", "data_workers",
+        "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
+        "profile_dir",
     ]
     return {k: getattr(args, k) for k in keys if getattr(args, k) is not None}
 
@@ -46,6 +53,17 @@ def main(argv=None) -> None:
     _add_override_flags(sub.add_parser("train"))
     _add_override_flags(sub.add_parser("eval"))
     sub.add_parser("bench")
+    p_exp = sub.add_parser("export-data",
+                           help="materialize the synthetic set as an npz cache")
+    p_exp.add_argument("--out", required=True)
+    p_exp.add_argument("--per-class", type=int, default=1000)
+    p_exp.add_argument("--resolution", type=int, default=64)
+    p_exp.add_argument("--seed", type=int, default=0)
+    p_bld = sub.add_parser("build-cache",
+                           help="voxelize an STL class tree into an npz cache")
+    p_bld.add_argument("--stl-root", required=True)
+    p_bld.add_argument("--out", required=True)
+    p_bld.add_argument("--resolution", type=int, default=64)
     args = parser.parse_args(argv)
 
     if args.distributed:
@@ -58,6 +76,26 @@ def main(argv=None) -> None:
 
         bench.main()
         return
+    if args.cmd == "export-data":
+        from featurenet_tpu.data.offline import export_synthetic_cache
+
+        index = export_synthetic_cache(
+            args.out, per_class=args.per_class,
+            resolution=args.resolution, seed=args.seed,
+        )
+        print(json.dumps({"exported": index["counts"]}))
+        return
+    if args.cmd == "build-cache":
+        from featurenet_tpu.data.offline import build_cache
+
+        index = build_cache(args.stl_root, args.out, resolution=args.resolution)
+        print(json.dumps({"built": index["counts"]}))
+        return
+
+    if getattr(args, "debug_nans", False):
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
 
     from featurenet_tpu.config import get_config
     from featurenet_tpu.train.loop import Trainer

@@ -31,13 +31,17 @@ class Config:
     # Task: "classify" (24-way logits) or "segment" (per-voxel dense logits).
     task: str = "classify"
 
-    # Data.
+    # Data. data_cache: path to an offline npz cache (``featurenet_tpu.data
+    # .offline``); None = on-the-fly synthetic generation. With a cache, eval
+    # runs a full deterministic pass over the held-out test split.
     resolution: int = 64
     global_batch: int = 96
     num_features: int = 1  # features carved per part (>1 for segmentation)
     eval_batches: int = 8
     data_workers: int = 2
     seed: int = 0
+    data_cache: Optional[str] = None
+    test_fraction: float = 0.2
 
     # Model.
     arch: FeatureNetArch = dataclasses.field(default_factory=FeatureNetArch)
@@ -57,6 +61,12 @@ class Config:
     # Shard the voxel depth axis over 'model' (XLA conv halo exchange) — the
     # 128³-grids-outgrow-HBM path. Needs mesh_model > 1 to have any effect.
     spatial: bool = False
+
+    # Profiling: when set, steps [profile_start, profile_start+profile_steps)
+    # are captured with jax.profiler into this directory (XProf/TensorBoard).
+    profile_dir: Optional[str] = None
+    profile_start: int = 10
+    profile_steps: int = 5
 
     # Logging / checkpointing.
     log_every: int = 50

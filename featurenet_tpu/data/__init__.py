@@ -8,7 +8,16 @@ from featurenet_tpu.data.synthetic import (
     generate_sample,
     generate_batch,
 )
-from featurenet_tpu.data.dataset import SyntheticVoxelDataset, prefetch_to_device
+from featurenet_tpu.data.dataset import (
+    SyntheticVoxelDataset,
+    prefetch_to_device,
+    put_batch,
+)
+from featurenet_tpu.data.offline import (
+    VoxelCacheDataset,
+    build_cache,
+    export_synthetic_cache,
+)
 
 __all__ = [
     "load_stl",
@@ -21,4 +30,8 @@ __all__ = [
     "generate_batch",
     "SyntheticVoxelDataset",
     "prefetch_to_device",
+    "put_batch",
+    "VoxelCacheDataset",
+    "build_cache",
+    "export_synthetic_cache",
 ]

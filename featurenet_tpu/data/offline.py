@@ -1,0 +1,224 @@
+"""Offline dataset passes: STL tree → voxel cache, and synthetic → cache.
+
+The reference's pipeline voxelizes the 24-class STL benchmark once and trains
+from cached arrays (SURVEY.md §3.2 — "offline pass → save .npy / in-memory
+cache"). This module is that pass, plus the cache reader:
+
+- Disk layout (input): ``root/<class_name>/<part>.stl`` — 24 class dirs, the
+  reference benchmark layout.
+- Cache layout (output): one ``.npz`` shard per class holding
+  ``voxels: uint8 [N, R, R, R]`` (bit-packed would save 8×; uint8 keeps
+  mmap-friendly simplicity at 64³ = 256 KiB/sample) and ``files: [N] str``
+  for provenance, plus a top-level ``index.json``.
+- ``VoxelCacheDataset`` streams shuffled, host-sharded batches from the
+  cache with the same dict contract as ``SyntheticVoxelDataset``
+  (``voxels/label/seg``; ``seg`` is all-zeros — STL parts carry no per-voxel
+  ground truth), so the Trainer is source-agnostic.
+- ``export_synthetic_cache`` materializes the parametric generator into the
+  same cache format, giving a fixed, reproducible on-disk dataset (the
+  train/test split used for the accuracy numbers in BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from featurenet_tpu.data.stl import load_stl
+from featurenet_tpu.data.synthetic import (
+    CLASS_NAMES,
+    generate_sample,
+)
+from featurenet_tpu.data.voxelize import voxelize
+
+
+def build_cache(
+    stl_root: str,
+    out_root: str,
+    resolution: int = 64,
+    classes: Sequence[str] | None = None,
+    backend: str = "auto",
+) -> dict:
+    """Voxelize an STL class tree into npz shards. Returns the index dict."""
+    os.makedirs(out_root, exist_ok=True)
+    classes = list(classes) if classes is not None else sorted(
+        d for d in os.listdir(stl_root)
+        if os.path.isdir(os.path.join(stl_root, d))
+    )
+    index = {"resolution": resolution, "classes": [], "counts": {}}
+    for cls in classes:
+        cdir = os.path.join(stl_root, cls)
+        files = sorted(f for f in os.listdir(cdir) if f.lower().endswith(".stl"))
+        grids = np.zeros(
+            (len(files), resolution, resolution, resolution), dtype=np.uint8
+        )
+        for i, f in enumerate(files):
+            tris = load_stl(os.path.join(cdir, f))
+            grids[i] = voxelize(
+                tris, resolution, fill=True, backend=backend
+            ).astype(np.uint8)
+        np.savez_compressed(
+            os.path.join(out_root, f"{cls}.npz"),
+            voxels=grids,
+            files=np.asarray(files),
+        )
+        index["classes"].append(cls)
+        index["counts"][cls] = len(files)
+    with open(os.path.join(out_root, "index.json"), "w") as fh:
+        json.dump(index, fh, indent=1)
+    return index
+
+
+def export_synthetic_cache(
+    out_root: str,
+    per_class: int = 100,
+    resolution: int = 64,
+    seed: int = 0,
+    orient: bool = True,
+) -> dict:
+    """Materialize the parametric generator into the npz cache format.
+
+    Gives a *fixed* dataset (reproducible from the seed) with a stable
+    train/test split downstream — the on-disk analog of the reference's
+    24 × 1000 benchmark.
+    """
+    os.makedirs(out_root, exist_ok=True)
+    index = {"resolution": resolution, "classes": [], "counts": {}, "seed": seed}
+    for cls_id, cls in enumerate(CLASS_NAMES):
+        rng = np.random.default_rng(
+            np.random.SeedSequence([seed, cls_id])
+        )
+        grids = np.zeros(
+            (per_class, resolution, resolution, resolution), dtype=np.uint8
+        )
+        for i in range(per_class):
+            part, _, _ = generate_sample(
+                rng, resolution, label=cls_id, orient=orient
+            )
+            grids[i] = part.astype(np.uint8)
+        np.savez_compressed(
+            os.path.join(out_root, f"{cls}.npz"),
+            voxels=grids,
+            files=np.asarray([f"synthetic_{i:05d}" for i in range(per_class)]),
+        )
+        index["classes"].append(cls)
+        index["counts"][cls] = per_class
+    with open(os.path.join(out_root, "index.json"), "w") as fh:
+        json.dump(index, fh, indent=1)
+    return index
+
+
+# One decompression per (cache dir, index mtime) per process: the Trainer
+# builds train+test instances over the same cache, and each class's grids
+# array is shared between them (the split is just a row mask).
+_cache_memo: dict = {}
+
+
+def _load_cache(cache_root: str):
+    index_path = os.path.join(cache_root, "index.json")
+    key = (os.path.abspath(cache_root), os.path.getmtime(index_path))
+    if key not in _cache_memo:
+        with open(index_path) as fh:
+            index = json.load(fh)
+        grids = {}
+        for cls in index["classes"]:
+            with np.load(os.path.join(cache_root, f"{cls}.npz")) as z:
+                grids[cls] = z["voxels"]
+        _cache_memo.clear()  # hold at most one cache resident
+        _cache_memo[key] = (index, grids)
+    return _cache_memo[key]
+
+
+class VoxelCacheDataset:
+    """Shuffled, host-sharded, infinite batch stream over a voxel cache.
+
+    Same contract as ``SyntheticVoxelDataset`` (``worker_iter`` / ``__iter__``
+    yielding ``{"voxels","label","seg"}``), so ``prefetch_to_device`` and the
+    Trainer work unchanged. ``split``: "train" or "test" — a deterministic
+    hash split per sample index (test_fraction of each class held out).
+    """
+
+    def __init__(
+        self,
+        cache_root: str,
+        global_batch: int = 96,
+        split: str = "train",
+        test_fraction: float = 0.2,
+        num_hosts: int = 1,
+        host_id: int = 0,
+        seed: int = 0,
+    ):
+        if global_batch % num_hosts != 0:
+            raise ValueError("global_batch must divide evenly across hosts")
+        self.index, grids = _load_cache(cache_root)
+        self.resolution = int(self.index["resolution"])
+        self.global_batch = global_batch
+        self.local_batch = global_batch // num_hosts
+        self.seed = seed
+        self.host_id = host_id
+
+        voxels, labels = [], []
+        for cls_id, cls in enumerate(self.index["classes"]):
+            g = grids[cls]
+            n = g.shape[0]
+            # Deterministic split: the same samples are held out regardless
+            # of host count or epoch (index-hash, not RNG order).
+            h = (np.arange(n) * 2654435761 % 1000) / 1000.0
+            keep = h >= test_fraction if split == "train" else h < test_fraction
+            voxels.append(g[keep])
+            labels.append(np.full(keep.sum(), cls_id, dtype=np.int32))
+        self.voxels = np.concatenate(voxels, axis=0)
+        self.labels = np.concatenate(labels, axis=0)
+        if len(self.labels) == 0:
+            raise ValueError(f"empty split {split!r} in {cache_root}")
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def worker_iter(
+        self, worker_id: int = 0, num_workers: int = 1
+    ) -> Iterator[dict[str, np.ndarray]]:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.host_id, worker_id])
+        )
+        R = self.resolution
+        n = len(self.labels)
+        while True:
+            idx = rng.integers(0, n, size=self.local_batch)
+            yield {
+                "voxels": self.voxels[idx, ..., None].astype(np.float32),
+                "label": self.labels[idx],
+                "seg": np.zeros(
+                    (self.local_batch, R, R, R), dtype=np.int32
+                ),
+                "mask": np.ones(self.local_batch, dtype=np.float32),
+            }
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return self.worker_iter(0, 1)
+
+    def epoch_batches(self, batch: int) -> Iterator[dict[str, np.ndarray]]:
+        """One exact pass over the split, every sample exactly once.
+
+        The final partial batch is padded (wrapping to the front) with
+        ``mask=0`` rows, so downstream masked sums count each held-out
+        sample exactly once while batch shapes stay static.
+        """
+        R = self.resolution
+        n = len(self.labels)
+        for s in range(0, n, batch):
+            idx = np.arange(s, min(s + batch, n))
+            mask = np.ones(batch, dtype=np.float32)
+            if len(idx) < batch:
+                mask[len(idx):] = 0.0
+                pad = np.arange(batch - len(idx)) % n  # wrap, split may be < batch
+                idx = np.concatenate([idx, pad])
+            yield {
+                "voxels": self.voxels[idx, ..., None].astype(np.float32),
+                "label": self.labels[idx],
+                "seg": np.zeros((batch, R, R, R), dtype=np.int32),
+                "mask": mask,
+            }

@@ -473,7 +473,9 @@ def generate_batch(
     num_features: int = 1,
     orient: bool = True,
 ) -> dict[str, np.ndarray]:
-    """Generate a batch dict: voxels [B,R,R,R,1] f32, label [B] i32, seg [B,R³] i32."""
+    """Generate a batch dict: voxels [B,R,R,R,1] f32, label [B] i32,
+    seg [B,R³] i32, mask [B] f32 (all-ones; padding masks come from exact
+    epoch passes in ``offline.VoxelCacheDataset``)."""
     R = resolution
     voxels = np.empty((batch_size, R, R, R, 1), dtype=np.float32)
     seg = np.empty((batch_size, R, R, R), dtype=np.int32)
@@ -486,4 +488,9 @@ def generate_batch(
         voxels[i, ..., 0] = part
         labels[i] = labs[0]
         seg[i] = s
-    return {"voxels": voxels, "label": labels, "seg": seg}
+    return {
+        "voxels": voxels,
+        "label": labels,
+        "seg": seg,
+        "mask": np.ones(batch_size, dtype=np.float32),
+    }

@@ -182,7 +182,11 @@ def voxelize(
     tris = np.asarray(triangles, dtype=np.float32)
     if normalize:
         tris = normalize_mesh(tris, margin=margin)
-    if backend != "numpy":
+    # The native path implements the parity fill and the exact shell; a
+    # "flood" fill request (hole-tolerant meshes) must stay on the numpy
+    # implementation rather than silently getting parity semantics.
+    native_ok = (not fill) or fill_method == "parity"
+    if backend != "numpy" and native_ok:
         try:
             from featurenet_tpu.native import voxelize_native
 

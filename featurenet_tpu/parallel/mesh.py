@@ -128,4 +128,5 @@ def batch_shardings(mesh: Mesh, spatial: bool = False) -> dict:
         "seg": NamedSharding(
             mesh, P("data", "model") if spatial else P("data")
         ),
+        "mask": NamedSharding(mesh, P("data")),
     }

@@ -110,25 +110,53 @@ class Trainer:
 
         # --- data -----------------------------------------------------------
         # Each host generates only its 1/process_count slice of the global
-        # batch (the DistributedSampler analog); device_put then assembles
+        # batch (the DistributedSampler analog); put_batch then assembles
         # the globally-sharded array from per-host slices.
         n_hosts, host_id = jax.process_count(), jax.process_index()
-        self.train_data = SyntheticVoxelDataset(
-            resolution=cfg.resolution,
-            global_batch=cfg.global_batch,
-            num_hosts=n_hosts,
-            host_id=host_id,
-            num_features=cfg.num_features,
-            seed=cfg.seed,
-        )
-        self.eval_data = SyntheticVoxelDataset(
-            resolution=cfg.resolution,
-            global_batch=cfg.global_batch,
-            num_hosts=n_hosts,
-            host_id=host_id,
-            num_features=cfg.num_features,
-            seed=cfg.seed + 10_000,
-        )
+        if cfg.data_cache:
+            if cfg.task == "segment":
+                raise ValueError(
+                    "data_cache stores no per-voxel ground truth (seg is "
+                    "all-zeros); task='segment' requires synthetic data"
+                )
+            from featurenet_tpu.data.offline import VoxelCacheDataset
+
+            self.train_data = VoxelCacheDataset(
+                cfg.data_cache,
+                global_batch=cfg.global_batch,
+                split="train",
+                test_fraction=cfg.test_fraction,
+                num_hosts=n_hosts,
+                host_id=host_id,
+                seed=cfg.seed,
+            )
+            # Held-out split, evaluated as full deterministic epoch passes.
+            self.eval_data = VoxelCacheDataset(
+                cfg.data_cache,
+                global_batch=cfg.global_batch,
+                split="test",
+                test_fraction=cfg.test_fraction,
+                num_hosts=n_hosts,
+                host_id=host_id,
+                seed=cfg.seed + 10_000,
+            )
+        else:
+            self.train_data = SyntheticVoxelDataset(
+                resolution=cfg.resolution,
+                global_batch=cfg.global_batch,
+                num_hosts=n_hosts,
+                host_id=host_id,
+                num_features=cfg.num_features,
+                seed=cfg.seed,
+            )
+            self.eval_data = SyntheticVoxelDataset(
+                resolution=cfg.resolution,
+                global_batch=cfg.global_batch,
+                num_hosts=n_hosts,
+                host_id=host_id,
+                num_features=cfg.num_features,
+                seed=cfg.seed + 10_000,
+            )
 
         self.ckpt: Optional[CheckpointManager] = None
         if cfg.checkpoint_dir:
@@ -142,10 +170,18 @@ class Trainer:
         return 0
 
     def evaluate(self) -> dict[str, float]:
-        it = iter(self.eval_data)
+        if hasattr(self.eval_data, "epoch_batches"):
+            # Cache-backed: one exact pass over the held-out split. (Multi-
+            # host note: every host walks the same epoch, so global batches
+            # repeat each sample process_count times — accuracy is still
+            # exact, just redundantly computed; fine at this dataset scale.)
+            batches = self.eval_data.epoch_batches(self.eval_data.local_batch)
+        else:
+            it = iter(self.eval_data)
+            batches = (next(it) for _ in range(self.cfg.eval_batches))
         sums = []
-        for _ in range(self.cfg.eval_batches):
-            batch = put_batch(next(it), self.batch_sh)
+        for host_batch in batches:
+            batch = put_batch(host_batch, self.batch_sh)
             sums.append(self._eval_step(
                 self.state.params, self.state.batch_stats, batch
             ))
@@ -165,17 +201,36 @@ class Trainer:
         )
         self.logger.start_window()
         last = {}
+        # Resume-safe profiling window: anchored at the first step this run
+        # actually executes, and always closed before the loop exits.
+        trace_start = max(cfg.profile_start, start) if cfg.profile_dir else -1
+        trace_active = False
         for step in range(start, total):
+            if step == trace_start:
+                jax.profiler.start_trace(cfg.profile_dir)
+                trace_active = True
             batch = next(stream)
             self.state, metrics = self._train_step(
                 self.state, batch, self._step_rng
             )
+            if trace_active and (
+                step + 1 >= trace_start + cfg.profile_steps
+                or step + 1 == total
+            ):
+                jax.block_until_ready(metrics)
+                jax.profiler.stop_trace()
+                trace_active = False
             self.logger.count_samples(cfg.global_batch)
             if (step + 1) % cfg.log_every == 0 or step + 1 == total:
                 last = self.logger.log(step + 1, metrics)
             if (step + 1) % cfg.eval_every == 0 or step + 1 == total:
                 ev = self.evaluate()
-                self.logger.log(step + 1, ev, prefix="eval")
+                # The 24×24 confusion matrix stays out of the log stream.
+                self.logger.log(
+                    step + 1,
+                    {k: v for k, v in ev.items() if k != "confusion"},
+                    prefix="eval",
+                )
                 last = {**last, **{f"eval_{k}": v for k, v in ev.items()}}
                 # Don't charge eval wall time to the next train window.
                 self.logger.start_window()

@@ -115,32 +115,56 @@ def make_eval_step(model, task: str = "classify") -> Callable:
             batch["voxels"],
             train=False,
         )
+        # Per-sample validity mask: padding rows (from exact epoch passes
+        # whose split doesn't divide the batch) contribute zero everywhere,
+        # keeping the executable shape-monomorphic while the sums stay exact.
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(batch["voxels"].shape[0], jnp.float32)
         if task == "classify":
             pred = jnp.argmax(logits, axis=-1)
-            correct = (pred == batch["label"]).sum()
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, batch["label"]
+            hit = (pred == batch["label"]).astype(jnp.float32)
+            correct = (hit * mask).sum()
+            loss = (
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["label"]
+                )
+                * mask
             ).sum()
+            n_cls = logits.shape[-1]
+            # Confusion counts [true, pred] — reference C7's per-class
+            # accuracy / confusion matrix (SURVEY.md §2), summed exactly.
+            confusion = (
+                jax.nn.one_hot(batch["label"], n_cls, dtype=jnp.float32)[
+                    :, :, None
+                ]
+                * jax.nn.one_hot(pred, n_cls, dtype=jnp.float32)[:, None, :]
+                * mask[:, None, None]
+            ).sum(0)
             return {
                 "correct": correct,
                 "loss_sum": loss,
-                "count": jnp.asarray(batch["label"].shape[0], jnp.int32),
+                "count": mask.sum(),
+                "confusion": confusion,
             }
         seg = batch["seg"]
         pred = jnp.argmax(logits, axis=-1)
         n_cls = logits.shape[-1]
-        pred_1h = jax.nn.one_hot(pred, n_cls, dtype=jnp.float32)
-        true_1h = jax.nn.one_hot(seg, n_cls, dtype=jnp.float32)
+        vmask = mask[:, None, None, None]
+        pred_1h = jax.nn.one_hot(pred, n_cls, dtype=jnp.float32) * vmask[..., None]
+        true_1h = jax.nn.one_hot(seg, n_cls, dtype=jnp.float32) * vmask[..., None]
         axes = tuple(range(pred_1h.ndim - 1))
         inter = (pred_1h * true_1h).sum(axes)  # [C+1]
         union = pred_1h.sum(axes) + true_1h.sum(axes) - inter
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, seg
+        loss = (
+            optax.softmax_cross_entropy_with_integer_labels(logits, seg)
+            * vmask
         ).sum()
+        voxels_per_sample = seg.shape[1] * seg.shape[2] * seg.shape[3]
         return {
-            "correct": (pred == seg).sum(),
+            "correct": ((pred == seg).astype(jnp.float32) * vmask).sum(),
             "loss_sum": loss,
-            "count": jnp.asarray(seg.size, jnp.int32),
+            "count": mask.sum() * voxels_per_sample,
             "intersection": inter,
             "union": union,
         }
@@ -160,6 +184,16 @@ def aggregate_eval(metric_list: list[dict]) -> dict[str, float]:
         "accuracy": float(total["correct"] / total["count"]),
         "loss": float(total["loss_sum"] / total["count"]),
     }
+    if "confusion" in total:
+        conf = np.asarray(total["confusion"])
+        row = conf.sum(axis=1)
+        per_class = np.where(row > 0, np.diag(conf) / np.maximum(row, 1), 0.0)
+        seen = row > 0
+        out["per_class_accuracy"] = per_class.round(4).tolist()
+        out["mean_class_accuracy"] = float(
+            per_class[seen].mean() if seen.any() else 0.0
+        )
+        out["confusion"] = conf.astype(int).tolist()
     if "intersection" in total:
         union = total["union"]
         present = union > 0  # ignore classes absent from both pred & truth

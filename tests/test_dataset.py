@@ -68,7 +68,8 @@ def test_device_put_with_sharding():
         ds,
         sharding={"voxels": NamedSharding(mesh, P("data")),
                   "label": sharding,
-                  "seg": sharding},
+                  "seg": sharding,
+                  "mask": sharding},
     )
     batch = next(it)
     shards = batch["voxels"].addressable_shards

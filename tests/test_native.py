@@ -1,0 +1,76 @@
+"""Native C++ voxelizer vs numpy reference (SURVEY.md §2 native ledger)."""
+
+import numpy as np
+import pytest
+
+from featurenet_tpu.data.mesh_primitives import mesh_box, mesh_cylinder
+from featurenet_tpu.data.voxelize import (
+    _rasterize_surface,
+    _voxelize_parity,
+    normalize_mesh,
+    voxelize,
+)
+
+native = pytest.importorskip("featurenet_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="g++ toolchain not available"
+)
+
+
+@pytest.mark.parametrize("R", [8, 16, 32])
+def test_fill_matches_numpy_parity_exactly(R):
+    """Same jitter, same rule → bit-identical solids on watertight meshes."""
+    for tris in (mesh_box(), mesh_cylinder(), mesh_box((0.3, 0.1, 0.2), (0.9, 0.75, 0.66))):
+        t = normalize_mesh(tris)
+        ref = _voxelize_parity(t, R)
+        got = native.voxelize_native(t, R, fill=True)
+        np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("R", [8, 16])
+def test_surface_superset_of_sampling(R):
+    """Exact SAT shell must cover every voxel the sampling rasterizer marks
+    (samples lie on the triangle, so sampled voxels truly intersect it)."""
+    for tris in (mesh_box(), mesh_cylinder()):
+        t = normalize_mesh(tris)
+        sampled = _rasterize_surface(t, R)
+        exact = native.voxelize_native(t, R, fill=False)
+        assert (sampled & ~exact).sum() == 0
+
+
+def test_surface_is_a_shell_not_solid():
+    t = normalize_mesh(mesh_box())
+    shell = native.voxelize_native(t, 16, fill=False)
+    solid = native.voxelize_native(t, 16, fill=True)
+    assert 0 < shell.sum() < solid.sum()
+    # Interior of the box must be empty in the shell.
+    assert not shell[8, 8, 8]
+
+
+def test_voxelize_auto_backend_dispatches_native():
+    tris = mesh_box()
+    via_auto = voxelize(tris, 16, fill=True, backend="auto")
+    via_native = voxelize(tris, 16, fill=True, backend="native")
+    via_numpy = voxelize(tris, 16, fill=True, backend="numpy")
+    np.testing.assert_array_equal(via_auto, via_native)
+    np.testing.assert_array_equal(via_native, via_numpy)
+
+
+def test_native_throughput_exceeds_numpy():
+    """The point of native: don't starve the TPU (SURVEY.md §7 hard part 1)."""
+    import time
+
+    t = normalize_mesh(mesh_cylinder())
+    # Warm both paths (native includes one-time g++ build via available()).
+    native.voxelize_native(t, 64, fill=True)
+    _voxelize_parity(t, 64)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        native.voxelize_native(t, 64, fill=True)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(5):
+        _voxelize_parity(t, 64)
+    t_numpy = time.perf_counter() - t0
+    assert t_native < t_numpy, (t_native, t_numpy)
