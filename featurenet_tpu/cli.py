@@ -4,6 +4,7 @@ SURVEY.md §2 C8 — argparse over resolution/batch/lr/epochs/data/world-size).
 Usage:
     python -m featurenet_tpu.cli train --config pod64 [--overrides…]
     python -m featurenet_tpu.cli eval  --config pod64 --checkpoint-dir D
+    python -m featurenet_tpu.cli infer --checkpoint-dir D part.stl [more.stl…]
     python -m featurenet_tpu.cli bench
     python -m featurenet_tpu.cli export-data --out D [--per-class N]
     python -m featurenet_tpu.cli build-cache --stl-root S --out D
@@ -32,6 +33,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-workers", type=int)
     p.add_argument("--data-cache", help="offline npz cache dir (see export-data)")
     p.add_argument("--profile-dir", help="capture an XProf trace here")
+    p.add_argument("--no-augment", action="store_true",
+                   help="disable train-time pose augmentation (cache-backed)")
     p.add_argument("--debug-nans", action="store_true",
                    help="jax_debug_nans: fail fast on the op producing a NaN")
 
@@ -42,7 +45,10 @@ def _overrides(args) -> dict:
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir",
     ]
-    return {k: getattr(args, k) for k in keys if getattr(args, k) is not None}
+    out = {k: getattr(args, k) for k in keys if getattr(args, k) is not None}
+    if getattr(args, "no_augment", False):
+        out["augment"] = False
+    return out
 
 
 def main(argv=None) -> None:
@@ -64,6 +70,14 @@ def main(argv=None) -> None:
     p_bld.add_argument("--stl-root", required=True)
     p_bld.add_argument("--out", required=True)
     p_bld.add_argument("--resolution", type=int, default=64)
+    p_inf = sub.add_parser("infer",
+                           help="classify STL files with a trained checkpoint")
+    p_inf.add_argument("stl", nargs="+", help="STL file path(s)")
+    p_inf.add_argument("--checkpoint-dir", required=True)
+    p_inf.add_argument("--config", default="pod64")
+    p_inf.add_argument("--resolution", type=int,
+                       help="must match the trained checkpoint's resolution "
+                            "when the run overrode the preset")
     args = parser.parse_args(argv)
 
     if args.distributed:
@@ -90,6 +104,19 @@ def main(argv=None) -> None:
 
         index = build_cache(args.stl_root, args.out, resolution=args.resolution)
         print(json.dumps({"built": index["counts"]}))
+        return
+    if args.cmd == "infer":
+        from featurenet_tpu.config import get_config
+        from featurenet_tpu.infer import Predictor
+
+        over = (
+            {"resolution": args.resolution} if args.resolution else {}
+        )
+        pred = Predictor.from_checkpoint(
+            args.checkpoint_dir, get_config(args.config, **over)
+        )
+        for r in pred.predict_stl(args.stl):
+            print(json.dumps(dataclasses.asdict(r)))
         return
 
     if getattr(args, "debug_nans", False):

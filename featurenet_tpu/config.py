@@ -42,6 +42,9 @@ class Config:
     seed: int = 0
     data_cache: Optional[str] = None
     test_fraction: float = 0.2
+    # Train-time pose augmentation (cube-group rotations) for cache-backed
+    # training; synthetic streaming already randomizes pose at generation.
+    augment: bool = True
 
     # Model.
     arch: FeatureNetArch = dataclasses.field(default_factory=FeatureNetArch)

@@ -31,6 +31,7 @@ from featurenet_tpu.data.stl import load_stl
 from featurenet_tpu.data.synthetic import (
     CLASS_NAMES,
     generate_sample,
+    random_orientation,
 )
 from featurenet_tpu.data.voxelize import voxelize
 
@@ -112,8 +113,9 @@ def export_synthetic_cache(
 
 
 # One decompression per (cache dir, index mtime) per process: the Trainer
-# builds train+test instances over the same cache, and each class's grids
-# array is shared between them (the split is just a row mask).
+# builds train+test instances over the same cache, and both index into the
+# memo's per-class arrays — no dataset-private copy of the grids exists, so
+# steady-state host RAM is one resident cache regardless of dataset count.
 _cache_memo: dict = {}
 
 
@@ -139,6 +141,12 @@ class VoxelCacheDataset:
     yielding ``{"voxels","label","seg"}``), so ``prefetch_to_device`` and the
     Trainer work unchanged. ``split``: "train" or "test" — a deterministic
     hash split per sample index (test_fraction of each class held out).
+
+    ``augment=True`` applies a random rotation from the 24-element cube group
+    to every sample drawn (train-time pose augmentation — the paper's ×24
+    orientation augmentation, SURVEY.md §2 C3 — on top of whatever pose was
+    baked in at export time). Machining-feature class is pose-invariant, so
+    the label is unchanged. Exact epoch passes (eval) never augment.
     """
 
     def __init__(
@@ -150,6 +158,7 @@ class VoxelCacheDataset:
         num_hosts: int = 1,
         host_id: int = 0,
         seed: int = 0,
+        augment: bool = False,
     ):
         if global_batch % num_hosts != 0:
             raise ValueError("global_batch must divide evenly across hosts")
@@ -159,21 +168,40 @@ class VoxelCacheDataset:
         self.local_batch = global_batch // num_hosts
         self.seed = seed
         self.host_id = host_id
+        self.augment = augment
 
-        voxels, labels = [], []
+        # Index into the shared memo arrays instead of copying rows out:
+        # sample m is self._grids[self.labels[m]][self.rows[m]]. Only the
+        # per-batch gather below materializes sample copies.
+        self._grids = [grids[cls] for cls in self.index["classes"]]
+        rows, labels = [], []
         for cls_id, cls in enumerate(self.index["classes"]):
-            g = grids[cls]
-            n = g.shape[0]
+            n = self._grids[cls_id].shape[0]
             # Deterministic split: the same samples are held out regardless
             # of host count or epoch (index-hash, not RNG order).
             h = (np.arange(n) * 2654435761 % 1000) / 1000.0
             keep = h >= test_fraction if split == "train" else h < test_fraction
-            voxels.append(g[keep])
+            rows.append(np.nonzero(keep)[0].astype(np.int64))
             labels.append(np.full(keep.sum(), cls_id, dtype=np.int32))
-        self.voxels = np.concatenate(voxels, axis=0)
-        self.labels = np.concatenate(labels, axis=0)
+        self.rows = np.concatenate(rows)
+        self.labels = np.concatenate(labels)
         if len(self.labels) == 0:
             raise ValueError(f"empty split {split!r} in {cache_root}")
+
+    def _gather(
+        self, idx: np.ndarray, rng: np.random.Generator | None = None
+    ) -> np.ndarray:
+        """Materialize ``[len(idx), R, R, R, 1]`` float32 voxels for samples
+        ``idx``, applying pose augmentation per sample when ``rng`` is given.
+        Rotation happens on the uint8 grids, then one cast — 4× less host
+        memory traffic than rotating float32 copies."""
+        samples = []
+        for m in idx:
+            g = self._grids[self.labels[m]][self.rows[m]]
+            if rng is not None:
+                g = random_orientation(rng)(g)
+            samples.append(g)
+        return np.stack(samples)[..., None].astype(np.float32)
 
     def __len__(self) -> int:
         return len(self.labels)
@@ -188,8 +216,9 @@ class VoxelCacheDataset:
         n = len(self.labels)
         while True:
             idx = rng.integers(0, n, size=self.local_batch)
+            voxels = self._gather(idx, rng if self.augment else None)
             yield {
-                "voxels": self.voxels[idx, ..., None].astype(np.float32),
+                "voxels": voxels,
                 "label": self.labels[idx],
                 "seg": np.zeros(
                     (self.local_batch, R, R, R), dtype=np.int32
@@ -217,7 +246,7 @@ class VoxelCacheDataset:
                 pad = np.arange(batch - len(idx)) % n  # wrap, split may be < batch
                 idx = np.concatenate([idx, pad])
             yield {
-                "voxels": self.voxels[idx, ..., None].astype(np.float32),
+                "voxels": self._gather(idx),
                 "label": self.labels[idx],
                 "seg": np.zeros((batch, R, R, R), dtype=np.int32),
                 "mask": mask,

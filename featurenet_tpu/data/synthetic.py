@@ -399,12 +399,14 @@ _FEATURE_FNS: tuple[Callable, ...] = (
 assert len(_FEATURE_FNS) == NUM_CLASSES
 
 
-def _random_orientation(rng: np.random.Generator):
+def random_orientation(rng: np.random.Generator):
     """One of the 24 rotations of the cube group, as a grid transform.
 
     The paper augments each part with its 24 axis-aligned orientations
     (SURVEY.md §2 C3); applying a random one at generation time gives the
-    model the same orientation invariance pressure.
+    model the same orientation invariance pressure. Also applied at train
+    time by ``offline.VoxelCacheDataset(augment=True)`` so a fixed on-disk
+    dataset still sees all 24 poses of every part.
     """
     perm = list(rng.permutation(3))
     flips = [bool(rng.integers(0, 2)) for _ in range(3)]
@@ -454,13 +456,13 @@ def generate_sample(
             # don't stack every feature on the same (top/-x) faces. Overlap is
             # possible; carving uses the *remaining* part so overlapped voxels
             # keep the earlier feature's label.
-            removal = _random_orientation(rng)(removal)
+            removal = random_orientation(rng)(removal)
         carved = removal & part
         seg[carved] = cls + 1
         part &= ~removal
 
     if orient:
-        o = _random_orientation(rng)
+        o = random_orientation(rng)
         part, seg = o(part), o(seg)
     return part, labels, seg
 

@@ -186,6 +186,11 @@ def voxelize(
     # "flood" fill request (hole-tolerant meshes) must stay on the numpy
     # implementation rather than silently getting parity semantics.
     native_ok = (not fill) or fill_method == "parity"
+    if backend == "native" and not native_ok:
+        raise ValueError(
+            "backend='native' has no flood fill; use fill_method='parity' "
+            "or backend='numpy'/'auto'"
+        )
     if backend != "numpy" and native_ok:
         try:
             from featurenet_tpu.native import voxelize_native

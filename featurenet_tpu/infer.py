@@ -1,0 +1,151 @@
+"""Inference: trained checkpoint → class predictions for voxel grids or STL.
+
+The reference had no serving path — eval doubled as inference (SURVEY.md §2
+C7). This module is the missing capability done TPU-style: one AOT-jitted,
+fixed-shape forward (padded to a static batch so every call hits the compile
+cache), fed either by in-memory grids or by the full STL → normalize →
+voxelize front end.
+
+Usage:
+    p = Predictor.from_checkpoint("ckpts/", config=get_config("pod64"))
+    labels, probs = p.predict_voxels(grids)          # [N,R,R,R] occupancy
+    results = p.predict_stl(["part.stl", ...])       # end-to-end
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from featurenet_tpu.config import Config, get_config
+from featurenet_tpu.data.stl import load_stl
+from featurenet_tpu.data.synthetic import CLASS_NAMES
+from featurenet_tpu.data.voxelize import voxelize
+
+
+@dataclasses.dataclass
+class Prediction:
+    path: str
+    label: int
+    class_name: str
+    prob: float
+    top3: list[tuple[str, float]]
+
+
+class Predictor:
+    """Fixed-shape compiled classifier forward over a trained checkpoint.
+
+    ``batch`` is the static compile shape; inputs are padded up / chunked to
+    it. Single-device by design (serving a ~5M-param model never needs a
+    mesh); the params live wherever ``jax.jit`` places them.
+    """
+
+    def __init__(self, params, batch_stats, cfg: Config, batch: int = 32):
+        import jax
+
+        from featurenet_tpu.train.loop import build_model
+
+        self.cfg = cfg
+        self.batch = batch
+        self.model = build_model(cfg)
+        self._params = params
+        self._stats = batch_stats
+
+        def forward(params, batch_stats, voxels):
+            logits = self.model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                voxels,
+                train=False,
+            )
+            return jax.nn.softmax(logits, axis=-1)
+
+        self._forward = jax.jit(forward)
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint_dir: str,
+        config: Config | str = "pod64",
+        batch: int = 32,
+    ) -> "Predictor":
+        """Restore params/batch_stats from an Orbax run directory.
+
+        The optimizer state in the checkpoint is restored (Orbax needs the
+        full tree) and immediately dropped — inference keeps weights only.
+        """
+        import jax
+
+        from featurenet_tpu.train.checkpoint import CheckpointManager
+        from featurenet_tpu.train.state import create_state
+        from featurenet_tpu.train.loop import build_model
+        from featurenet_tpu.train.steps import make_optimizer
+
+        cfg = get_config(config) if isinstance(config, str) else config
+        model = build_model(cfg)
+        sample = np.zeros(
+            (1, cfg.resolution, cfg.resolution, cfg.resolution, 1), np.float32
+        )
+        state = create_state(
+            model, make_optimizer(cfg), sample, jax.random.key(0)
+        )
+        mgr = CheckpointManager(checkpoint_dir)
+        state = mgr.restore(state)
+        mgr.close()
+        return cls(state.params, state.batch_stats, cfg, batch=batch)
+
+    # -- prediction ---------------------------------------------------------
+    def predict_voxels(
+        self, grids: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Classify ``[N, R, R, R]`` (or ``[N,R,R,R,1]``) occupancy grids.
+
+        Returns ``(labels int32 [N], probs float32 [N, num_classes])``.
+        Inputs are chunked/padded to the static compile batch.
+        """
+        g = np.asarray(grids, dtype=np.float32)
+        if g.ndim == 4:
+            g = g[..., None]
+        R = self.cfg.resolution
+        if g.shape[1:] != (R, R, R, 1):
+            raise ValueError(
+                f"expected [N,{R},{R},{R}(,1)] grids, got {g.shape}"
+            )
+        n = g.shape[0]
+        probs = []
+        for s in range(0, n, self.batch):
+            chunk = g[s : s + self.batch]
+            pad = self.batch - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad,) + chunk.shape[1:], np.float32)]
+                )
+            p = np.asarray(self._forward(self._params, self._stats, chunk))
+            probs.append(p[: self.batch - pad])
+        probs = np.concatenate(probs, axis=0)
+        return probs.argmax(axis=-1).astype(np.int32), probs
+
+    def predict_stl(
+        self, paths: Sequence[str], fill: bool = True
+    ) -> list[Prediction]:
+        """End-to-end: STL file → normalized voxel grid → class prediction."""
+        R = self.cfg.resolution
+        grids = np.stack(
+            [voxelize(load_stl(p), R, fill=fill) for p in paths]
+        )
+        labels, probs = self.predict_voxels(grids)
+        out = []
+        for path, lab, pr in zip(paths, labels, probs):
+            order = np.argsort(pr)[::-1][:3]
+            out.append(
+                Prediction(
+                    path=path,
+                    label=int(lab),
+                    class_name=CLASS_NAMES[int(lab)],
+                    prob=float(pr[lab]),
+                    top3=[(CLASS_NAMES[i], float(pr[i])) for i in order],
+                )
+            )
+        return out

@@ -49,7 +49,13 @@ def _load() -> ctypes.CDLL:
         if (not os.path.exists(_LIB)
                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
             _build()
-        lib = ctypes.CDLL(_LIB)
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            # A stale/foreign-ABI .so (e.g. committed from another platform,
+            # with checkout mtimes masking it as fresh): rebuild and retry.
+            _build()
+            lib = ctypes.CDLL(_LIB)
         for fn in (lib.fn_voxelize_surface, lib.fn_voxelize_fill):
             fn.restype = ctypes.c_int
             fn.argtypes = [

@@ -129,6 +129,7 @@ class Trainer:
                 num_hosts=n_hosts,
                 host_id=host_id,
                 seed=cfg.seed,
+                augment=cfg.augment,
             )
             # Held-out split, evaluated as full deterministic epoch passes.
             self.eval_data = VoxelCacheDataset(
@@ -205,38 +206,44 @@ class Trainer:
         # actually executes, and always closed before the loop exits.
         trace_start = max(cfg.profile_start, start) if cfg.profile_dir else -1
         trace_active = False
-        for step in range(start, total):
-            if step == trace_start:
-                jax.profiler.start_trace(cfg.profile_dir)
-                trace_active = True
-            batch = next(stream)
-            self.state, metrics = self._train_step(
-                self.state, batch, self._step_rng
-            )
-            if trace_active and (
-                step + 1 >= trace_start + cfg.profile_steps
-                or step + 1 == total
-            ):
-                jax.block_until_ready(metrics)
-                jax.profiler.stop_trace()
-                trace_active = False
-            self.logger.count_samples(cfg.global_batch)
-            if (step + 1) % cfg.log_every == 0 or step + 1 == total:
-                last = self.logger.log(step + 1, metrics)
-            if (step + 1) % cfg.eval_every == 0 or step + 1 == total:
-                ev = self.evaluate()
-                # The 24×24 confusion matrix stays out of the log stream.
-                self.logger.log(
-                    step + 1,
-                    {k: v for k, v in ev.items() if k != "confusion"},
-                    prefix="eval",
+        try:
+            for step in range(start, total):
+                if step == trace_start:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    trace_active = True
+                batch = next(stream)
+                self.state, metrics = self._train_step(
+                    self.state, batch, self._step_rng
                 )
-                last = {**last, **{f"eval_{k}": v for k, v in ev.items()}}
-                # Don't charge eval wall time to the next train window.
-                self.logger.start_window()
-            if self.ckpt and ((step + 1) % cfg.checkpoint_every == 0
-                              or step + 1 == total):
-                self.ckpt.save(self.state)
+                if trace_active and (
+                    step + 1 >= trace_start + cfg.profile_steps
+                    or step + 1 == total
+                ):
+                    jax.block_until_ready(metrics)
+                    jax.profiler.stop_trace()
+                    trace_active = False
+                self.logger.count_samples(cfg.global_batch)
+                if (step + 1) % cfg.log_every == 0 or step + 1 == total:
+                    last = self.logger.log(step + 1, metrics)
+                if (step + 1) % cfg.eval_every == 0 or step + 1 == total:
+                    ev = self.evaluate()
+                    # The 24×24 confusion matrix stays out of the log stream.
+                    self.logger.log(
+                        step + 1,
+                        {k: v for k, v in ev.items() if k != "confusion"},
+                        prefix="eval",
+                    )
+                    last = {**last, **{f"eval_{k}": v for k, v in ev.items()}}
+                    # Don't charge eval wall time to the next train window.
+                    self.logger.start_window()
+                if self.ckpt and ((step + 1) % cfg.checkpoint_every == 0
+                                  or step + 1 == total):
+                    self.ckpt.save(self.state)
+        finally:
+            if trace_active:
+                # An exception mid-window must not lose the trace of the
+                # failing steps (the ones worth inspecting).
+                jax.profiler.stop_trace()
         if self.ckpt:
             self.ckpt.wait()
         return last
