@@ -35,6 +35,12 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile-dir", help="capture an XProf trace here")
     p.add_argument("--no-augment", action="store_true",
                    help="disable train-time pose augmentation (cache-backed)")
+    p.add_argument("--no-stem-s2d", action="store_true",
+                   help="use the direct strided conv instead of the "
+                        "space-to-depth stem (matches checkpoints trained "
+                        "with stem_s2d=False)")
+    p.add_argument("--conv-backend", choices=["xla", "pallas"],
+                   help="backend for stride-1 conv blocks (default xla)")
     p.add_argument("--debug-nans", action="store_true",
                    help="jax_debug_nans: fail fast on the op producing a NaN")
 
@@ -49,6 +55,19 @@ def _overrides(args) -> dict:
     if getattr(args, "no_augment", False):
         out["augment"] = False
     return out
+
+
+def _apply_arch_overrides(cfg, args):
+    arch_kw = {}
+    if getattr(args, "no_stem_s2d", False):
+        arch_kw["stem_s2d"] = False
+    if getattr(args, "conv_backend", None):
+        arch_kw["conv_backend"] = args.conv_backend
+    if arch_kw:
+        cfg = dataclasses.replace(
+            cfg, arch=dataclasses.replace(cfg.arch, **arch_kw)
+        ).validate()
+    return cfg
 
 
 def main(argv=None) -> None:
@@ -127,7 +146,9 @@ def main(argv=None) -> None:
     from featurenet_tpu.config import get_config
     from featurenet_tpu.train.loop import Trainer
 
-    cfg = get_config(args.config, **_overrides(args))
+    cfg = _apply_arch_overrides(
+        get_config(args.config, **_overrides(args)), args
+    )
     print(json.dumps({"config": dataclasses.asdict(cfg)}, default=str))
     trainer = Trainer(cfg)
     if args.cmd == "train":

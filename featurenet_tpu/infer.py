@@ -114,6 +114,11 @@ class Predictor:
                 f"expected [N,{R},{R},{R}(,1)] grids, got {g.shape}"
             )
         n = g.shape[0]
+        if n == 0:
+            return (
+                np.zeros((0,), np.int32),
+                np.zeros((0, len(CLASS_NAMES)), np.float32),
+            )
         probs = []
         for s in range(0, n, self.batch):
             chunk = g[s : s + self.batch]
@@ -131,6 +136,8 @@ class Predictor:
         self, paths: Sequence[str], fill: bool = True
     ) -> list[Prediction]:
         """End-to-end: STL file → normalized voxel grid → class prediction."""
+        if not paths:
+            return []
         R = self.cfg.resolution
         grids = np.stack(
             [voxelize(load_stl(p), R, fill=fill) for p in paths]

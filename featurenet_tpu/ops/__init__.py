@@ -1,0 +1,24 @@
+"""TPU-first custom ops.
+
+The reference (torch FeatureNet, SURVEY.md §2 C1/C6) leans on cuDNN for its
+3D convolutions; here the compute path is XLA — and where XLA's lowering is
+measurably weak, this package supplies the fix:
+
+- ``stem``: space-to-depth reformulation of strided convolutions. XLA:TPU
+  lowers the paper's 7³/stride-2/1-channel stem at ~10 TF/s (measured,
+  BASELINE.md); the s2d-transformed equivalent runs at the MXU's preferred
+  shapes for a measured 5.3x layer speedup. Numerically identical.
+- ``conv3d``: a Pallas shift-and-matmul 3D convolution (fp32, stride 1) with
+  a custom VJP, as an alternative backend to XLA's conv lowering, plus the
+  microbenchmark that decides which backend the model uses.
+"""
+
+from featurenet_tpu.ops.stem import SpaceToDepthConv, space_to_depth_conv
+from featurenet_tpu.ops.conv3d import conv3d_p, pallas_conv_supported
+
+__all__ = [
+    "SpaceToDepthConv",
+    "space_to_depth_conv",
+    "conv3d_p",
+    "pallas_conv_supported",
+]

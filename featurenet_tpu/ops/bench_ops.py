@@ -1,0 +1,126 @@
+"""Microbenchmarks for the custom ops — the evidence behind backend defaults.
+
+Run on TPU:  python -m featurenet_tpu.ops.bench_ops
+Prints one JSON line per case; measured results are recorded in BASELINE.md.
+
+Timing method: the op is chained N times inside one compiled ``lax.scan``
+(output projected back to the input's channel count between iterations), so a
+measurement is a single dispatch — per-call dispatch latency through this
+environment's tunneled TPU is milliseconds-scale and noisy, which would swamp
+sub-millisecond kernels. Per-op time = (wall(scan 2N) - wall(scan N)) / N,
+with a device→host readback as the sync point (``block_until_ready`` returns
+early on the tunneled backend; a readback is the honest wall).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _chain(f, iters):
+    import jax
+    import jax.numpy as jnp
+
+    def run(x, w):
+        cin = x.shape[-1]
+
+        def body(c, _):
+            y = f(c, w)
+            if y.shape == x.shape:
+                nxt = y
+            elif y.shape[-1] >= cin and y.shape[:-1] == x.shape[:-1]:
+                nxt = y[..., :cin]
+            else:
+                # Strided op: shape changes — re-feed x, but thread a tiny
+                # data dependency on y through the carry so the scan body
+                # cannot be dead-code-eliminated.
+                nxt = x + (jnp.tanh(jnp.mean(y)) * 1e-12).astype(x.dtype)
+            return nxt.astype(x.dtype), ()
+
+        out, _ = jax.lax.scan(body, x, None, length=iters)
+        return out
+
+    return jax.jit(run)
+
+
+def scan_time(f, x, w, iters: int = 128) -> float:
+    """Per-op seconds via scan-chained slope timing (see module docstring)."""
+    import jax.numpy as jnp
+
+    short, long_ = _chain(f, iters), _chain(f, 2 * iters)
+
+    def wall(g, repeats: int = 5):
+        y = g(x, w)  # warm/compile
+        float(jnp.sum(y[(0,) * (y.ndim - 1)]))
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            y = g(x, w)
+            float(jnp.sum(y[(0,) * (y.ndim - 1)]))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return (wall(long_) - wall(short)) / iters
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.ops.conv3d import conv3d_p, pallas_conv_supported
+    from featurenet_tpu.ops.stem import space_to_depth_conv
+
+    rng = np.random.default_rng(0)
+
+    def xla_conv(stride):
+        def f(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (stride,) * 3, "SAME",
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            )
+        return f
+
+    # --- stem: direct stride-2 vs space-to-depth ----------------------------
+    B, R, K, Cout = 96, 64, 7, 32
+    x = jnp.asarray(rng.standard_normal((B, R, R, R, 1)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((K, K, K, 1, Cout)) * 0.1, jnp.bfloat16)
+    t_direct = scan_time(xla_conv(2), x, w, iters=32)
+    t_s2d = scan_time(
+        lambda x, w: space_to_depth_conv(x, w, 2), x, w, iters=32
+    )
+    flops = 2 * B * (R // 2) ** 3 * K ** 3 * Cout
+    for name, t in [("stem7_direct", t_direct), ("stem7_s2d", t_s2d)]:
+        print(json.dumps({
+            "metric": name, "value": round(t * 1e3, 3), "unit": "ms",
+            "tflops": round(flops / t / 1e12, 1),
+        }))
+    print(json.dumps({
+        "metric": "stem7_s2d_speedup", "value": round(t_direct / t_s2d, 2),
+        "unit": "x",
+    }))
+
+    # --- stride-1 blocks: XLA vs Pallas (fp32 — kernel dtype constraint) ----
+    for name, B, R, Cin, Cout, K in [
+        ("conv2_32r_k5", 32, 32, 32, 32, 5),
+        ("conv3_16r_k3", 32, 16, 32, 64, 3),
+        ("conv4_16r_k3", 32, 16, 64, 64, 3),
+    ]:
+        x = jnp.asarray(rng.standard_normal((B, R, R, R, Cin)), jnp.float32)
+        w = jnp.asarray(
+            rng.standard_normal((K, K, K, Cin, Cout)) * 0.1, jnp.float32
+        )
+        t_xla = scan_time(xla_conv(1), x, w)
+        row = {"metric": f"{name}_xla_fp32", "value": round(t_xla * 1e3, 3),
+               "unit": "ms"}
+        if pallas_conv_supported(x.shape, K, Cout, x.dtype):
+            t_pal = scan_time(conv3d_p, x, w)
+            row["pallas_ms"] = round(t_pal * 1e3, 3)
+            row["pallas_vs_xla"] = round(t_xla / t_pal, 2)
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

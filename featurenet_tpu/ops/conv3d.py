@@ -1,0 +1,259 @@
+"""Pallas TPU 3D convolution: shift-and-matmul, with a custom VJP.
+
+An alternative backend to XLA's conv lowering for the stride-1 SAME conv
+blocks (the FLOPs bulk of FeatureNet — SURVEY.md §3.3). The reference gets
+these from cuDNN (SURVEY.md §2 C6, third-party native); XLA's own lowering is
+the primary TPU path here, and this kernel is the first-party native
+alternative, selectable per-arch (``FeatureNetArch.conv_backend``) and kept
+honest by ``featurenet_tpu.ops.bench_ops`` — measured numbers in BASELINE.md
+decide the default (XLA today: its conv lowering runs at 60–140 TF/s on the
+hot shapes, and this kernel is not yet ahead of it).
+
+Kernel design (per TPU constraints, see /opt/skills/guides/pallas_guide.md):
+
+- Grid over the batch; each program owns one padded sample in VMEM, with
+  Pallas' pipeline double-buffering HBM→VMEM behind compute.
+- The K³ taps become K³ MXU matmuls ``[TZ·H·W, Cin] @ [Cin, Cout]``
+  accumulated in an fp32 VMEM scratch (bf16-style mixed precision is the
+  MXU's native mode; here inputs are fp32 — see the dtype note).
+- Tap shifts: z rides the fori z-chunk loop (dynamic slice on a free dim),
+  y is a static free-dim slice, and x — the sublane dimension, where Mosaic
+  requires 8-aligned slice starts — is done with ``pltpu.roll`` (a sublane
+  rotate), hoisted to K rolls per z-chunk.
+- dw: same structure, contracting over positions instead of channels, with
+  the [K,K,K,Cin,Cout] output block accumulated across the whole grid.
+- dx: stride-1 SAME with odd K is its own transpose — the forward kernel
+  applied to the cotangent with spatially-flipped, channel-transposed
+  weights.
+
+Dtype note: Mosaic's sublane rotate is 32-bit only ("Rotate with non-32-bit
+data"), so the compiled path requires fp32. bf16 callers fall back to XLA
+(``pallas_conv_supported`` gates this); off-TPU the kernel runs in interpret
+mode for CI (tests/conftest.py's virtual-CPU platform).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under ~16 MiB/core
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_tz(d: int, h: int, w: int, k: int, cin: int, cout: int, itemsize: int):
+    """Largest z-chunk whose fp32 accumulator keeps the program in VMEM."""
+    dp, hp, wp = d + k - 1, h + k - 1, w + k - 1
+    fixed = (
+        2 * dp * hp * wp * cin * itemsize  # x block, double-buffered
+        + 2 * d * h * w * cout * itemsize  # out block, double-buffered
+        + k ** 3 * cin * cout * itemsize   # weights
+    )
+    for tz in range(min(d, 8), 0, -1):
+        if d % tz:
+            continue
+        if fixed + tz * h * w * cout * 4 <= _VMEM_BUDGET:
+            return tz
+    return None
+
+
+def pallas_conv_supported(shape, k: int, cout: int, dtype) -> bool:
+    """True when the compiled kernel handles this conv (see dtype note)."""
+    if len(shape) != 5 or k % 2 == 0:
+        return False
+    _, d, h, w, cin = shape
+    if dtype != jnp.float32 and not _interpret():
+        return False  # sublane rotate is 32-bit only on real TPU
+    return _pick_tz(d, h, w, k, cin, cout, jnp.dtype(dtype).itemsize) is not None
+
+
+def _fwd_kernel(k, tz, d, h, w, cin, cout, out_dtype):
+    n = tz * h * w
+    wp = w + k - 1
+
+    def kernel(x_ref, w_ref, out_ref, acc_ref):
+        def chunk(zc, carry):
+            xs_full = x_ref[0, pl.ds(zc * tz, tz + k - 1)]
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            for kx in range(k):
+                xx = (
+                    pltpu.roll(xs_full, wp - kx, axis=2) if kx else xs_full
+                )[:, :, 0:w, :]
+                for kz in range(k):
+                    for ky in range(k):
+                        xs = xx[kz : kz + tz, ky : ky + h].reshape(n, cin)
+                        acc_ref[:] = acc_ref[:] + jnp.dot(
+                            xs,
+                            w_ref[kz, ky, kx],
+                            preferred_element_type=jnp.float32,
+                        )
+            out_ref[0, pl.ds(zc * tz, tz)] = (
+                acc_ref[:].reshape(tz, h, w, cout).astype(out_dtype)
+            )
+            return carry
+
+        jax.lax.fori_loop(0, d // tz, chunk, 0)
+
+    return kernel
+
+
+def _dw_kernel(k, tz, d, h, w, cin, cout):
+    n = tz * h * w
+    wp = w + k - 1
+
+    def kernel(x_ref, g_ref, dw_ref):
+        b = pl.program_id(0)
+
+        @pl.when(b == 0)
+        def _():
+            dw_ref[...] = jnp.zeros_like(dw_ref)
+
+        def chunk(zc, carry):
+            xs_full = x_ref[0, pl.ds(zc * tz, tz + k - 1)]
+            gs = g_ref[0, pl.ds(zc * tz, tz)].reshape(n, cout)
+            for kx in range(k):
+                xx = (
+                    pltpu.roll(xs_full, wp - kx, axis=2) if kx else xs_full
+                )[:, :, 0:w, :]
+                for kz in range(k):
+                    for ky in range(k):
+                        xs = xx[kz : kz + tz, ky : ky + h].reshape(n, cin)
+                        dw_ref[kz, ky, kx] = dw_ref[kz, ky, kx] + jax.lax.dot_general(
+                            xs,
+                            gs,
+                            (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                        )
+            return carry
+
+        jax.lax.fori_loop(0, d // tz, chunk, 0)
+
+    return kernel
+
+
+def _conv_fwd(x, w):
+    b, d, h, w_, cin = x.shape
+    k, cout = w.shape[0], w.shape[-1]
+    p = (k - 1) // 2
+    tz = _pick_tz(d, h, w_, k, cin, cout, x.dtype.itemsize)
+    if tz is None:
+        raise ValueError(f"conv3d_p: shapes {x.shape} exceed the VMEM plan")
+    xp = jnp.pad(x, ((0, 0), (p, p), (p, p), (p, p), (0, 0)))
+    return pl.pallas_call(
+        _fwd_kernel(k, tz, d, h, w_, cin, cout, x.dtype),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, d + k - 1, h + k - 1, w_ + k - 1, cin),
+                lambda i: (i, 0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (k, k, k, cin, cout),
+                lambda i: (0, 0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, d, h, w_, cout), lambda i: (i, 0, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, d, h, w_, cout), x.dtype),
+        scratch_shapes=[pltpu.VMEM((tz * h * w_, cout), jnp.float32)],
+        interpret=_interpret(),
+    )(xp, w.astype(x.dtype))
+
+
+def _conv_dw(x, g, k):
+    b, d, h, w_, cin = x.shape
+    cout = g.shape[-1]
+    p = (k - 1) // 2
+    tz = _pick_tz(d, h, w_, k, cin, cout, x.dtype.itemsize)
+    xp = jnp.pad(x, ((0, 0), (p, p), (p, p), (p, p), (0, 0)))
+    return pl.pallas_call(
+        _dw_kernel(k, tz, d, h, w_, cin, cout),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, d + k - 1, h + k - 1, w_ + k - 1, cin),
+                lambda i: (i, 0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, d, h, w_, cout), lambda i: (i, 0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (k, k, k, cin, cout),
+            lambda i: (0, 0, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((k, k, k, cin, cout), jnp.float32),
+        interpret=_interpret(),
+    )(xp, g)
+
+
+@jax.custom_vjp
+def conv3d_p(x, w):
+    """Stride-1 SAME 3D conv, odd K: ``[B,D,H,W,Cin] x [K,K,K,Cin,Cout]``."""
+    return _conv_fwd(x, w)
+
+
+def _vjp_fwd(x, w):
+    return _conv_fwd(x, w), (x, w)
+
+
+def _vjp_bwd(res, g):
+    x, w = res
+    k = w.shape[0]
+    # dx: correlate the cotangent with the spatially-flipped,
+    # channel-transposed kernel (stride-1 SAME odd-K is self-transposed).
+    w_flip = jnp.flip(w, axis=(0, 1, 2)).swapaxes(3, 4)
+    dx = _conv_fwd(g, w_flip.astype(g.dtype))
+    dw = _conv_dw(x, g, k).astype(w.dtype)
+    return dx, dw
+
+
+conv3d_p.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+class PallasConv(nn.Module):
+    """Stride-1 SAME conv block backed by ``conv3d_p`` (no bias).
+
+    Parameter ``kernel`` matches ``nn.Conv``'s shape/init. The compiled
+    kernel is fp32 (see module docstring), so activations are computed in
+    fp32 through this layer and cast back to ``dtype``; shapes the VMEM plan
+    can't hold fall back to XLA's conv with the same parameters.
+    """
+
+    features: int
+    kernel_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        k, cin = self.kernel_size, x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(batch_axis=(), in_axis=(0, 1, 2, 3)),
+            (k, k, k, cin, self.features),
+            jnp.float32,
+        )
+        xf = x.astype(jnp.float32)
+        if pallas_conv_supported(xf.shape, k, self.features, xf.dtype):
+            out = conv3d_p(xf, kernel)
+        else:
+            out = jax.lax.conv_general_dilated(
+                xf,
+                kernel,
+                (1, 1, 1),
+                "SAME",
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            )
+        return out.astype(self.dtype)

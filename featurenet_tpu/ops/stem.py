@@ -1,0 +1,148 @@
+"""Space-to-depth reformulation of strided 3D convolutions.
+
+Why this exists (measured on TPU v5e, see BASELINE.md): the paper-shape stem —
+7³ kernel, stride 2, **one** input channel on a 64³ grid (SURVEY.md §3.3) — is
+the worst possible shape for XLA:TPU's conv lowering. The channel dimension is
+the MXU contraction axis, and with C_in=1 the systolic array runs at 1/128th
+occupancy: measured 10 TF/s vs 60–140 TF/s for the later C_in≥32 layers.
+SURVEY.md §7 flagged exactly this ("7×7×7 stride-2 conv lowering on TPU",
+hard part #4).
+
+The fix is algebraic, not a hand-written kernel: a stride-``s`` convolution
+over ``x`` equals a stride-1 convolution over the space-to-depth transform of
+``x`` (each s³ block of voxels becomes s³ channels) with a re-indexed weight
+tensor. The transform multiplies the contraction axis by s³ (1 → 8 for the
+stem) and shrinks the spatial extent by s per axis, which XLA lowers at far
+better MXU occupancy — measured 5.3x faster than the direct stride-2 conv
+(slope-timed; BASELINE.md), the same math to rounding error.
+
+Derivation. With SAME padding, ``out[o] = Σ_k x[s·o + k - p_lo] · w[k]`` per
+axis, ``p_lo = (K - s) // 2``. Write ``k - p_lo = s·a + r`` with ``r ∈ [0,s)``:
+the input index becomes ``s·(o + a) + r`` — i.e. tap ``a`` of a stride-1 conv
+over the parity-``r`` subgrid. Taps ``a`` span ``[a_min, a_max]`` with
+``a_min = floor(-p_lo / s)``, so the transformed conv has kernel size
+``a_max - a_min + 1`` and asymmetric padding ``(-a_min, a_max)``.
+
+The parameter stays in the reference's shape ``[K, K, K, C_in, C_out]``; the
+scatter into the transformed weight ``w2`` is traced and differentiable, so
+autodiff produces exact gradients in the original parametrization. Leaf
+*shapes* match the direct formulation, but the Flax module (and hence the
+checkpoint tree path) differs — a checkpoint restores only under the
+``stem_s2d`` setting it was trained with.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _plan(resolution: int, kernel: int, stride: int):
+    """Static plan: tap index maps for the transformed weight.
+
+    Returns (k2, pads, src_idx, dst_idx): transformed kernel size, stride-1
+    padding (lo, hi), and flat scatter indices mapping original-weight taps
+    into the transformed weight (computed per axis, combined over 3 axes by
+    the caller).
+    """
+    if resolution % stride:
+        raise ValueError(f"resolution {resolution} not divisible by stride {stride}")
+    if kernel < stride:
+        raise ValueError("space-to-depth needs kernel >= stride")
+    pad_lo = (kernel - stride) // 2
+    a = np.arange(kernel)  # original tap index k per axis
+    shifted = a - pad_lo
+    tap = shifted // stride          # stride-1 tap index a (floor div)
+    parity = shifted - tap * stride  # r in [0, stride)
+    a_min, a_max = int(tap.min()), int(tap.max())
+    k2 = a_max - a_min + 1
+    return k2, (-a_min, a_max), tap - a_min, parity
+
+
+def space_to_depth(x: jnp.ndarray, s: int) -> jnp.ndarray:
+    """[B, D, H, W, C] → [B, D/s, H/s, W/s, s³·C]; channel = ((rz·s+ry)·s+rx)·C + c."""
+    b, d, h, w, c = x.shape
+    x = x.reshape(b, d // s, s, h // s, s, w // s, s, c)
+    x = x.transpose(0, 1, 3, 5, 2, 4, 6, 7)
+    return x.reshape(b, d // s, h // s, w // s, s * s * s * c)
+
+
+def transform_weights(w: jnp.ndarray, resolution: int, stride: int) -> tuple:
+    """Scatter ``w[K,K,K,Cin,Cout]`` into the stride-1 weight ``w2``.
+
+    Returns (w2, pads) where ``w2`` has shape [K2, K2, K2, s³·Cin, Cout] and
+    ``pads`` is the per-axis asymmetric (lo, hi) padding for the stride-1 conv.
+    Differentiable: ``w2`` is a traced scatter of ``w``.
+    """
+    k = w.shape[0]
+    cin, cout = w.shape[3], w.shape[4]
+    s = stride
+    k2, pads, tap, parity = _plan(resolution, k, s)
+    # Flat index arithmetic in numpy (static): for each original tap
+    # (kz, ky, kx) find its slot (az, ay, ax, parity-channel) in w2.
+    kz, ky, kx = np.meshgrid(np.arange(k), np.arange(k), np.arange(k), indexing="ij")
+    az, ay, ax = tap[kz], tap[ky], tap[kx]
+    pz, py, px = parity[kz], parity[ky], parity[kx]
+    pchan = (pz * s + py) * s + px  # parity block within the s³·Cin channels
+    w2 = jnp.zeros((k2, k2, k2, s * s * s, cin, cout), w.dtype)
+    w2 = w2.at[az.ravel(), ay.ravel(), ax.ravel(), pchan.ravel()].set(
+        w.reshape(k * k * k, cin, cout)
+    )
+    w2 = w2.reshape(k2, k2, k2, s * s * s * cin, cout)
+    return w2, (pads, pads, pads)
+
+
+def space_to_depth_conv(
+    x: jnp.ndarray, w: jnp.ndarray, stride: int
+) -> jnp.ndarray:
+    """Stride-``s`` SAME conv computed as a stride-1 conv on s2d(x).
+
+    ``x``: [B, R, R, R, Cin]; ``w``: [K, K, K, Cin, Cout] (the reference
+    parametrization). Matches ``lax.conv_general_dilated(..., (s,s,s),
+    "SAME")`` to rounding error, at MXU-friendly contraction size s³·Cin.
+    """
+    r = x.shape[1]
+    w2, pads = transform_weights(w, r, stride)
+    x2 = space_to_depth(x, stride)
+    return _conv_s1(x2, w2, pads)
+
+
+def _conv_s1(x2, w2, pads):
+    import jax
+
+    return jax.lax.conv_general_dilated(
+        x2,
+        w2,
+        window_strides=(1, 1, 1),
+        padding=list(pads),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+
+
+class SpaceToDepthConv(nn.Module):
+    """Drop-in strided conv block (no bias) using the s2d reformulation.
+
+    Parameter ``kernel`` has the same [K,K,K,Cin,Cout] shape and init as
+    ``nn.Conv``'s, so arch configs and param counts match the direct path.
+    """
+
+    features: int
+    kernel_size: int
+    stride: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        k = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(batch_axis=(), in_axis=(0, 1, 2, 3)),
+            (k, k, k, cin, self.features),
+            jnp.float32,
+        )
+        return space_to_depth_conv(
+            x.astype(self.dtype), kernel.astype(self.dtype), self.stride
+        )
