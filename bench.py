@@ -36,7 +36,7 @@ def main() -> None:
     import jax
 
     from featurenet_tpu.config import get_config
-    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.data.synthetic import generate_batch, to_wire
     from featurenet_tpu.models import FeatureNet
     from featurenet_tpu.parallel.mesh import (
         batch_shardings,
@@ -66,15 +66,19 @@ def main() -> None:
     st_sh = state_shardings(abstract, mesh)
     state = jax.jit(init_fn, out_shardings=st_sh)(jax.random.key(0))
 
-    b_sh = batch_shardings(mesh)
+    # The real classify wire format: bit-packed voxels, no per-voxel target,
+    # unpacked on device inside the compiled step.
+    b_sh = batch_shardings(mesh, keys=("voxels", "label", "mask"))
     step = jax.jit(
-        make_train_step(model, "classify"),
+        make_train_step(model, "classify", packed=True),
         in_shardings=(st_sh, b_sh, replicated(mesh)),
         out_shardings=(st_sh, replicated(mesh)),
         donate_argnums=(0,),
     )
 
-    host = generate_batch(np.random.default_rng(0), global_batch, 64)
+    host = to_wire(
+        generate_batch(np.random.default_rng(0), global_batch, 64), "classify"
+    )
     batch = jax.device_put(host, b_sh)
     rng = jax.device_put(jax.random.key(1), replicated(mesh))
 

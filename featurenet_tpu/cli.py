@@ -33,6 +33,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-workers", type=int)
     p.add_argument("--data-cache", help="offline npz cache dir (see export-data)")
     p.add_argument("--profile-dir", help="capture an XProf trace here")
+    p.add_argument("--tb-dir", help="mirror scalar metrics to TensorBoard "
+                                    "event files here")
     p.add_argument("--no-augment", action="store_true",
                    help="disable train-time pose augmentation (cache-backed)")
     p.add_argument("--no-stem-s2d", action="store_true",
@@ -49,7 +51,7 @@ def _overrides(args) -> dict:
     keys = [
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
-        "profile_dir",
+        "profile_dir", "tb_dir",
     ]
     out = {k: getattr(args, k) for k in keys if getattr(args, k) is not None}
     if getattr(args, "no_augment", False):

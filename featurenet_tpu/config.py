@@ -44,7 +44,12 @@ class Config:
     test_fraction: float = 0.2
     # Train-time pose augmentation (cube-group rotations) for cache-backed
     # training; synthetic streaming already randomizes pose at generation.
+    # augment_device moves the rotations into the compiled train step
+    # (ops/augment.py; classification only) so host workers just gather —
+    # augment_groups independent poses per batch.
     augment: bool = True
+    augment_device: bool = True
+    augment_groups: int = 8
 
     # Model.
     arch: FeatureNetArch = dataclasses.field(default_factory=FeatureNetArch)
@@ -65,13 +70,22 @@ class Config:
     # 128³-grids-outgrow-HBM path. Needs mesh_model > 1 to have any effect.
     spatial: bool = False
 
+    # Backpressure: max train steps dispatched ahead of confirmed execution.
+    # Async dispatch with no bound pins every in-flight batch in memory; on
+    # backends where block_until_ready is unreliable (this environment's
+    # tunneled TPU) only a readback confirms progress, so the loop forces a
+    # scalar readback of the metrics from `max_inflight_steps` ago.
+    max_inflight_steps: int = 8
+
     # Profiling: when set, steps [profile_start, profile_start+profile_steps)
     # are captured with jax.profiler into this directory (XProf/TensorBoard).
     profile_dir: Optional[str] = None
     profile_start: int = 10
     profile_steps: int = 5
 
-    # Logging / checkpointing.
+    # Logging / checkpointing. tb_dir: also mirror scalar metrics to
+    # TensorBoard event files (CLU metric_writers).
+    tb_dir: Optional[str] = None
     log_every: int = 50
     eval_every: int = 500
     checkpoint_every: int = 500
@@ -81,6 +95,17 @@ class Config:
     def validate(self) -> "Config":
         if self.task not in ("classify", "segment"):
             raise ValueError(f"unknown task {self.task!r}")
+        if self.augment and self.augment_device and self.augment_groups < 1:
+            raise ValueError(
+                "augment_groups must be >= 1 when device augmentation is "
+                "enabled (use augment=False or augment_device=False to "
+                "disable augmentation)"
+            )
+        if self.task == "classify" and self.resolution % 8:
+            raise ValueError(
+                "classify: resolution must be divisible by 8 (the wire "
+                "format bit-packs voxels along the W axis)"
+            )
         if self.resolution % 2:
             raise ValueError("resolution must be even")
         if self.task == "segment":
@@ -119,11 +144,17 @@ def xla32() -> Config:
 
 
 def pod64() -> Config:
+    # peak_lr: 1e-3 collapses this config into the uniform-output absorbing
+    # state within ~25 steps (loss pins at ln 24, grad norm → 0.1; measured
+    # on TPU v5e with fresh-stream 64³ batches — BASELINE.md). 3e-4 with a
+    # longer warmup trains stably; 1e-4 works too but slower.
     return Config(
         name="pod64",
         resolution=64,
         global_batch=96,
         total_steps=5000,
+        peak_lr=3e-4,
+        warmup_steps=200,
     ).validate()
 
 

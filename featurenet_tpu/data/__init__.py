@@ -7,6 +7,8 @@ from featurenet_tpu.data.synthetic import (
     NUM_CLASSES,
     generate_sample,
     generate_batch,
+    pack_voxels,
+    to_wire,
 )
 from featurenet_tpu.data.dataset import (
     SyntheticVoxelDataset,
@@ -28,6 +30,8 @@ __all__ = [
     "NUM_CLASSES",
     "generate_sample",
     "generate_batch",
+    "pack_voxels",
+    "to_wire",
     "SyntheticVoxelDataset",
     "prefetch_to_device",
     "put_batch",

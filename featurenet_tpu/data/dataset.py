@@ -23,7 +23,7 @@ from typing import Iterator
 
 import numpy as np
 
-from featurenet_tpu.data.synthetic import generate_batch
+from featurenet_tpu.data.synthetic import generate_batch, to_wire
 
 
 class SyntheticVoxelDataset:
@@ -37,6 +37,9 @@ class SyntheticVoxelDataset:
       num_features: 1 for classification, >1 for segmentation parts.
       seed: base seed; per-host and per-worker streams are independent
         ``SeedSequence`` folds of it.
+      task: wire format to emit (``data.synthetic.to_wire``) — classify ships
+        bit-packed voxels and no per-voxel target; None yields the rich
+        float batch (tests / custom consumers).
     """
 
     def __init__(
@@ -48,6 +51,7 @@ class SyntheticVoxelDataset:
         num_features: int = 1,
         balanced: bool = True,
         seed: int = 0,
+        task: str | None = None,
     ):
         if global_batch % num_hosts != 0:
             raise ValueError("global_batch must divide evenly across hosts")
@@ -58,6 +62,7 @@ class SyntheticVoxelDataset:
         self.balanced = balanced
         self.seed = seed
         self.host_id = host_id
+        self.task = task
 
     def worker_iter(
         self, worker_id: int = 0, num_workers: int = 1
@@ -67,13 +72,14 @@ class SyntheticVoxelDataset:
             np.random.SeedSequence([self.seed, self.host_id, worker_id])
         )
         while True:
-            yield generate_batch(
+            batch = generate_batch(
                 rng,
                 self.local_batch,
                 self.resolution,
                 balanced=self.balanced,
                 num_features=self.num_features,
             )
+            yield to_wire(batch, self.task) if self.task else batch
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         return self.worker_iter(0, 1)

@@ -1,0 +1,76 @@
+"""Device-side pose augmentation: the cube rotation group, inside the step.
+
+The paper augments training parts over the 24 axis-aligned orientations
+(SURVEY.md §2 C3); the host-side version (``data/offline.py`` ``augment=True``)
+rotates uint8 grids in the data workers. This module moves that work into the
+compiled train step: rotations are transposes+flips — pure layout ops that
+cost ~nothing on-device — so host workers only gather and cast, and the
+augmentation never bottlenecks the input pipeline.
+
+Batched-``switch`` caveat: a per-sample rotation code under ``vmap`` would
+lower to computing all 24 branches and selecting (24x the memory traffic).
+Instead the batch is split into ``groups`` contiguous slices, each rotated by
+one scalar-code ``lax.switch`` (single branch executed). Group count trades
+per-batch pose diversity against trace size; across steps every sample still
+sees uniformly-random poses.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+
+# The 24 rotations of the cube: axis permutations of (D, H, W) combined with
+# axis flips whose overall determinant is +1 (proper rotations only).
+CUBE_GROUP: list[tuple[tuple[int, int, int], tuple[bool, bool, bool]]] = []
+for _perm in itertools.permutations((0, 1, 2)):
+    _inv = sum(
+        1 for i in range(3) for j in range(i + 1, 3) if _perm[i] > _perm[j]
+    )
+    _perm_sign = -1 if _inv % 2 else 1
+    for _flips in itertools.product((False, True), repeat=3):
+        _flip_sign = -1 if sum(_flips) % 2 else 1
+        if _perm_sign * _flip_sign == 1:
+            CUBE_GROUP.append((_perm, _flips))
+assert len(CUBE_GROUP) == 24
+
+
+def apply_rotation(x: jnp.ndarray, perm, flips, spatial_start: int = 1):
+    """Apply one cube rotation to spatial dims [s, s+3) of ``x``."""
+    s = spatial_start
+    order = (
+        tuple(range(s))
+        + tuple(s + p for p in perm)
+        + tuple(range(s + 3, x.ndim))
+    )
+    x = jnp.transpose(x, order)
+    flip_axes = [s + i for i, f in enumerate(flips) if f]
+    return jnp.flip(x, flip_axes) if flip_axes else x
+
+
+def rotate_grids(x: jnp.ndarray, code, spatial_start: int = 1):
+    """Rotate ``x`` (spatial dims must be equal-length) by group element
+    ``code`` (scalar int in [0, 24)). Safe under jit; one branch executes."""
+    branches = [
+        (lambda g, p=p, f=f: apply_rotation(g, p, f, spatial_start))
+        for p, f in CUBE_GROUP
+    ]
+    return jax.lax.switch(code, branches, x)
+
+
+def random_rotate_batch(
+    voxels: jnp.ndarray, rng: jax.Array, groups: int = 8
+) -> jnp.ndarray:
+    """Rotate ``[B, R, R, R, C]`` voxels, one random pose per batch group."""
+    b = voxels.shape[0]
+    while b % groups:
+        groups -= 1
+    codes = jax.random.randint(rng, (groups,), 0, len(CUBE_GROUP))
+    step = b // groups
+    parts = [
+        rotate_grids(voxels[i * step : (i + 1) * step], codes[i])
+        for i in range(groups)
+    ]
+    return jnp.concatenate(parts, axis=0)

@@ -63,14 +63,34 @@ def _pick_tz(d: int, h: int, w: int, k: int, cin: int, cout: int, itemsize: int)
     return None
 
 
+def _dw_fits(d, h, w, k, cin, cout, itemsize) -> bool:
+    dp, hp, wp = d + k - 1, h + k - 1, w + k - 1
+    fixed = (
+        2 * dp * hp * wp * cin * itemsize  # x block, double-buffered
+        + 2 * d * h * w * cout * itemsize  # g block, double-buffered
+        + k ** 3 * cin * cout * 4          # dw accumulator (fp32 out)
+    )
+    return fixed <= _VMEM_BUDGET
+
+
 def pallas_conv_supported(shape, k: int, cout: int, dtype) -> bool:
-    """True when the compiled kernel handles this conv (see dtype note)."""
+    """True when the compiled kernel handles this conv *including its VJP*.
+
+    Training runs three kernels: forward, dx (forward with cin/cout swapped
+    — the cotangent has ``cout`` channels), and dw; all three VMEM plans
+    must fit, or gradient tracing would crash after the forward gate passed.
+    """
     if len(shape) != 5 or k % 2 == 0:
         return False
     _, d, h, w, cin = shape
     if dtype != jnp.float32 and not _interpret():
         return False  # sublane rotate is 32-bit only on real TPU
-    return _pick_tz(d, h, w, k, cin, cout, jnp.dtype(dtype).itemsize) is not None
+    itemsize = jnp.dtype(dtype).itemsize
+    return (
+        _pick_tz(d, h, w, k, cin, cout, itemsize) is not None
+        and _pick_tz(d, h, w, k, cout, cin, itemsize) is not None  # dx
+        and _dw_fits(d, h, w, k, cin, cout, itemsize)
+    )
 
 
 def _fwd_kernel(k, tz, d, h, w, cin, cout, out_dtype):
@@ -174,6 +194,8 @@ def _conv_dw(x, g, k):
     cout = g.shape[-1]
     p = (k - 1) // 2
     tz = _pick_tz(d, h, w_, k, cin, cout, x.dtype.itemsize)
+    if tz is None or not _dw_fits(d, h, w_, k, cin, cout, x.dtype.itemsize):
+        raise ValueError(f"conv3d_p dw: shapes {x.shape} exceed the VMEM plan")
     xp = jnp.pad(x, ((0, 0), (p, p), (p, p), (p, p), (0, 0)))
     return pl.pallas_call(
         _dw_kernel(k, tz, d, h, w_, cin, cout),

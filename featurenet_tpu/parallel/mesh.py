@@ -120,13 +120,24 @@ def state_shardings(state, mesh: Mesh):
     )
 
 
-def batch_shardings(mesh: Mesh, spatial: bool = False) -> dict:
-    """Sharding dict matching ``generate_batch`` output structure."""
-    return {
+def batch_shardings(
+    mesh: Mesh,
+    spatial: bool = False,
+    keys: tuple = ("voxels", "label", "seg", "mask"),
+) -> dict:
+    """Sharding dict for a wire batch (``data.synthetic.to_wire``).
+
+    ``keys`` selects the entries present in the task's wire format — the
+    classify wire carries no ``seg``, for instance. Volumetric entries
+    (voxels/seg — packed or not, the depth axis is still dim 1) additionally
+    shard depth over ``model`` when ``spatial`` is set.
+    """
+    vol = {
         "voxels": batch_sharding(mesh, spatial),
-        "label": NamedSharding(mesh, P("data")),
         "seg": NamedSharding(
             mesh, P("data", "model") if spatial else P("data")
         ),
-        "mask": NamedSharding(mesh, P("data")),
+    }
+    return {
+        k: vol.get(k, NamedSharding(mesh, P("data"))) for k in keys
     }

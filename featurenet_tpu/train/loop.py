@@ -16,6 +16,7 @@ Reference parity: ``train.py`` ``main()`` (SURVEY.md §3.1), redesigned:
 
 from __future__ import annotations
 
+import collections
 from typing import Optional
 
 import jax
@@ -60,7 +61,10 @@ class Trainer:
         self.spatial = cfg.spatial if spatial is None else spatial
         self.model = build_model(cfg)
         self.tx = make_optimizer(cfg)
-        self.logger = MetricLogger()
+        # TB events from host 0 only (multi-host runs would double-write).
+        self.logger = MetricLogger(
+            tb_dir=cfg.tb_dir if jax.process_index() == 0 else None
+        )
 
         n_data = self.mesh.shape["data"]
         if cfg.global_batch % (n_data or 1):
@@ -89,16 +93,37 @@ class Trainer:
         self.params_n = param_count(self.state.params)
 
         # --- compiled steps -------------------------------------------------
-        self.batch_sh = batch_shardings(self.mesh, spatial=self.spatial)
+        # Wire format: classify ships bit-packed voxels and no per-voxel
+        # target (unpacked on device inside the step); segment ships uint8
+        # voxels + int8 seg. Host→device bandwidth is the input pipeline's
+        # scarce resource — 32x less of it than float32 batches.
+        packed = cfg.task == "classify"
+        wire_keys = (
+            ("voxels", "label", "mask") if packed
+            else ("voxels", "seg", "mask")
+        )
+        self.batch_sh = batch_shardings(
+            self.mesh, spatial=self.spatial, keys=wire_keys
+        )
         rep = replicated(self.mesh)
+        # Cache-backed classification augments on device (rotations inside
+        # the compiled step); the host dataset then skips its rotation pass.
+        self._device_aug = bool(
+            cfg.data_cache and cfg.augment and cfg.augment_device
+            and cfg.augment_groups > 0 and cfg.task == "classify"
+        )
         self._train_step = jax.jit(
-            make_train_step(self.model, cfg.task, cfg.label_smoothing),
+            make_train_step(
+                self.model, cfg.task, cfg.label_smoothing,
+                augment_groups=cfg.augment_groups if self._device_aug else 0,
+                packed=packed,
+            ),
             in_shardings=(self.state_sh, self.batch_sh, rep),
             out_shardings=(self.state_sh, rep),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(
-            make_eval_step(self.model, cfg.task),
+            make_eval_step(self.model, cfg.task, packed=packed),
             in_shardings=(
                 self.state_sh.params,
                 self.state_sh.batch_stats,
@@ -129,7 +154,7 @@ class Trainer:
                 num_hosts=n_hosts,
                 host_id=host_id,
                 seed=cfg.seed,
-                augment=cfg.augment,
+                augment=cfg.augment and not self._device_aug,
             )
             # Held-out split, evaluated as full deterministic epoch passes.
             self.eval_data = VoxelCacheDataset(
@@ -149,6 +174,7 @@ class Trainer:
                 host_id=host_id,
                 num_features=cfg.num_features,
                 seed=cfg.seed,
+                task=cfg.task,
             )
             self.eval_data = SyntheticVoxelDataset(
                 resolution=cfg.resolution,
@@ -157,6 +183,7 @@ class Trainer:
                 host_id=host_id,
                 num_features=cfg.num_features,
                 seed=cfg.seed + 10_000,
+                task=cfg.task,
             )
 
         self.ckpt: Optional[CheckpointManager] = None
@@ -206,6 +233,10 @@ class Trainer:
         # actually executes, and always closed before the loop exits.
         trace_start = max(cfg.profile_start, start) if cfg.profile_dir else -1
         trace_active = False
+        # Dispatch-depth bound: hold the metrics of the last K steps; reading
+        # one scalar from step N-K before dispatching step N+1 guarantees at
+        # most K steps (and their pinned host batches) are ever in flight.
+        pending: collections.deque = collections.deque()
         try:
             for step in range(start, total):
                 if step == trace_start:
@@ -215,6 +246,9 @@ class Trainer:
                 self.state, metrics = self._train_step(
                     self.state, batch, self._step_rng
                 )
+                pending.append(metrics["loss"])
+                if len(pending) > max(cfg.max_inflight_steps, 1):
+                    float(pending.popleft())  # readback = proof of progress
                 if trace_active and (
                     step + 1 >= trace_start + cfg.profile_steps
                     or step + 1 == total
@@ -244,6 +278,11 @@ class Trainer:
                 # An exception mid-window must not lose the trace of the
                 # failing steps (the ones worth inspecting).
                 jax.profiler.stop_trace()
+            # Flush buffered TB events even when the run dies mid-loop —
+            # the crashed run is the one worth inspecting. Flush, not close:
+            # the same Trainer may run()/evaluate() again and must keep
+            # mirroring to TB.
+            self.logger.flush()
         if self.ckpt:
             self.ckpt.wait()
         return last

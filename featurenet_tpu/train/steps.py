@@ -21,6 +21,23 @@ import optax
 from featurenet_tpu.train.state import TrainState
 
 
+def unpack_voxels(packed: jax.Array) -> jax.Array:
+    """Device-side inverse of ``data.synthetic.pack_voxels``.
+
+    ``[B, R, R, R/8] uint8`` → ``[B, R, R, R, 1] float32``. Bit-packed wire
+    batches are 32x smaller than float32 over the host→device link; the
+    unpack (shift+mask+reshape) fuses into the first conv's input read.
+    """
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)  # packbits is MSB-first
+    bits = (packed[..., None] >> shifts) & jnp.uint8(1)
+    b, d, h, w8 = packed.shape
+    return bits.reshape(b, d, h, w8 * 8, 1).astype(jnp.float32)
+
+
+def _batch_voxels(batch: dict, packed: bool) -> jax.Array:
+    return unpack_voxels(batch["voxels"]) if packed else batch["voxels"]
+
+
 def classification_loss(
     logits: jax.Array,  # [B, C] fp32
     labels: jax.Array,  # [B] int32
@@ -68,32 +85,49 @@ def make_train_step(
     model,
     task: str = "classify",
     label_smoothing: float = 0.0,
+    augment_groups: int = 0,
+    packed: bool = False,
 ) -> Callable:
-    """Build the pure train-step function (jit it with shardings at call site)."""
+    """Build the pure train-step function (jit it with shardings at call site).
+
+    ``augment_groups > 0`` applies device-side cube-group pose augmentation
+    (ops/augment.py) to the voxels inside the compiled step — classification
+    only (the label is pose-invariant; per-voxel targets would need the same
+    rotation). ``packed=True`` expects bit-packed wire voxels (the classify
+    wire format) and unpacks them on device.
+    """
+
+    if augment_groups and task != "classify":
+        raise ValueError("device augmentation supports task='classify' only")
 
     target_key = "label" if task == "classify" else "seg"
 
-    def loss_fn(params, batch_stats, batch, dropout_rng):
+    def loss_fn(params, batch_stats, voxels, target, dropout_rng):
         out, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats},
-            batch["voxels"],
+            voxels,
             train=True,
             rngs={"dropout": dropout_rng},
             mutable=["batch_stats"],
         )
         if task == "classify":
-            loss, metrics = classification_loss(
-                out, batch[target_key], label_smoothing
-            )
+            loss, metrics = classification_loss(out, target, label_smoothing)
         else:
-            loss, metrics = segmentation_loss(out, batch[target_key])
+            loss, metrics = segmentation_loss(out, target.astype(jnp.int32))
         return loss, (mutated["batch_stats"], metrics)
 
     def train_step(state: TrainState, batch, rng):
         # Fold the step index in so dropout differs per step from one base key.
-        dropout_rng = jax.random.fold_in(rng, state.step)
+        step_rng = jax.random.fold_in(rng, state.step)
+        dropout_rng, aug_rng = jax.random.split(step_rng)
+        voxels = _batch_voxels(batch, packed)
+        if augment_groups:
+            from featurenet_tpu.ops.augment import random_rotate_batch
+
+            voxels = random_rotate_batch(voxels, aug_rng, augment_groups)
         grads, (new_stats, metrics) = jax.grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, batch, dropout_rng
+            state.params, state.batch_stats, voxels, batch[target_key],
+            dropout_rng
         )
         state = state.apply_gradients(grads=grads, batch_stats=new_stats)
         metrics["grad_norm"] = optax.global_norm(grads)
@@ -102,7 +136,9 @@ def make_train_step(
     return train_step
 
 
-def make_eval_step(model, task: str = "classify") -> Callable:
+def make_eval_step(
+    model, task: str = "classify", packed: bool = False
+) -> Callable:
     """Eval step returning *sums* (not means) so batches aggregate exactly.
 
     For segmentation it also returns per-class intersection/union counts so
@@ -110,9 +146,10 @@ def make_eval_step(model, task: str = "classify") -> Callable:
     """
 
     def eval_step(params, batch_stats, batch):
+        voxels = _batch_voxels(batch, packed)
         logits = model.apply(
             {"params": params, "batch_stats": batch_stats},
-            batch["voxels"],
+            voxels,
             train=False,
         )
         # Per-sample validity mask: padding rows (from exact epoch passes
@@ -120,7 +157,7 @@ def make_eval_step(model, task: str = "classify") -> Callable:
         # keeping the executable shape-monomorphic while the sums stay exact.
         mask = batch.get("mask")
         if mask is None:
-            mask = jnp.ones(batch["voxels"].shape[0], jnp.float32)
+            mask = jnp.ones(voxels.shape[0], jnp.float32)
         if task == "classify":
             pred = jnp.argmax(logits, axis=-1)
             hit = (pred == batch["label"]).astype(jnp.float32)
@@ -147,7 +184,7 @@ def make_eval_step(model, task: str = "classify") -> Callable:
                 "count": mask.sum(),
                 "confusion": confusion,
             }
-        seg = batch["seg"]
+        seg = batch["seg"].astype(jnp.int32)
         pred = jnp.argmax(logits, axis=-1)
         n_cls = logits.shape[-1]
         vmask = mask[:, None, None, None]

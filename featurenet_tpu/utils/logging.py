@@ -1,9 +1,13 @@
-"""Metric logging: JSON-lines scalars to stdout (+ history for tests).
+"""Metric logging: JSON-lines scalars to stdout (+ history for tests),
+optionally mirrored to TensorBoard event files via CLU.
 
 The reference printed loss to stdout (SURVEY.md §5 "Metrics"). Here every log
 event is one machine-parseable JSON line, and throughput is measured honestly:
 ``samples/sec`` windows are walled with ``block_until_ready`` on the metric
-pytree, so async dispatch can't inflate the number.
+pytree, so async dispatch can't inflate the number. Pass ``tb_dir`` (CLI
+``--tb-dir``) to also write scalar summaries as TB events (CLU
+``metric_writers`` — the SURVEY.md §5 observability plan); vector metrics
+(e.g. per-class accuracy) stay JSON-only.
 """
 
 from __future__ import annotations
@@ -18,11 +22,16 @@ import numpy as np
 
 
 class MetricLogger:
-    def __init__(self, stream=None):
+    def __init__(self, stream=None, tb_dir: str | None = None):
         self.stream = stream or sys.stdout
         self.history: list[dict[str, Any]] = []
         self._window_start: float | None = None
         self._window_samples = 0
+        self._tb = None
+        if tb_dir:
+            from clu import metric_writers
+
+            self._tb = metric_writers.SummaryWriter(tb_dir)
 
     def start_window(self) -> None:
         self._window_start = time.perf_counter()
@@ -44,4 +53,21 @@ class MetricLogger:
             self.start_window()
         self.history.append(record)
         print(json.dumps(record), file=self.stream, flush=True)
+        if self._tb is not None:
+            scalars = {
+                f"{prefix}/{k}": v
+                for k, v in record.items()
+                if isinstance(v, float) and k not in ("step",)
+            }
+            if scalars:
+                self._tb.write_scalars(int(step), scalars)
         return record
+
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None

@@ -52,10 +52,15 @@ def test_cache_dataset_contract(stl_tree, tmp_path):
     ds = VoxelCacheDataset(out, global_batch=4, split="train",
                            test_fraction=0.25)
     b = next(iter(ds))
-    assert b["voxels"].shape == (4, 16, 16, 16, 1)
-    assert b["voxels"].dtype == np.float32
+    # Classify wire format: bit-packed voxels, no per-voxel target.
+    assert b["voxels"].shape == (4, 16, 16, 2)
+    assert b["voxels"].dtype == np.uint8
     assert b["label"].shape == (4,)
-    assert b["seg"].shape == (4, 16, 16, 16)
+    assert "seg" not in b
+    # Unpacking recovers a plausible solid-part occupancy.
+    unpacked = np.unpackbits(b["voxels"], axis=-1)
+    assert unpacked.shape == (4, 16, 16, 16)
+    assert unpacked.mean() > 0.05
 
 
 def test_split_disjoint_and_complete(stl_tree, tmp_path):
@@ -94,10 +99,10 @@ def test_augmented_stream_preserves_content(tmp_path):
     aug = VoxelCacheDataset(out, global_batch=8, split="train",
                             test_fraction=0.0, seed=11, augment=True)
     bp, ba = next(iter(plain)), next(iter(aug))
-    # Rotation is volume-preserving: per-sample occupancy counts match.
-    np.testing.assert_array_equal(
-        bp["voxels"].sum(axis=(1, 2, 3, 4)), ba["voxels"].sum(axis=(1, 2, 3, 4))
-    )
+    # Rotation is volume-preserving: per-sample occupancy counts match
+    # (popcount of the packed bytes).
+    count = lambda b: np.unpackbits(b["voxels"], axis=-1).sum(axis=(1, 2, 3))
+    np.testing.assert_array_equal(count(bp), count(ba))
     # Augmentation consumes extra RNG draws, so the *sample index* streams
     # diverge after batch 1 — only compare labels of the first batch.
     np.testing.assert_array_equal(bp["label"], ba["label"])

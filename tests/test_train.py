@@ -95,3 +95,20 @@ def test_segmentation_step_runs(rng):
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_tensorboard_events(tmp_path):
+    """tb_dir writes TB event files alongside the JSON-lines stream."""
+    import os
+
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.train import Trainer
+
+    cfg = get_config(
+        "smoke16", total_steps=4, log_every=2, eval_every=10**9,
+        checkpoint_every=10**9, data_workers=1, global_batch=8,
+        tb_dir=str(tmp_path / "tb"),
+    )
+    Trainer(cfg).run()
+    files = os.listdir(tmp_path / "tb")
+    assert any("tfevents" in f for f in files), files
