@@ -36,7 +36,7 @@ def main() -> None:
     import jax
 
     from featurenet_tpu.config import get_config
-    from featurenet_tpu.data.synthetic import generate_batch, to_wire
+    from featurenet_tpu.data.synthetic import WIRE_KEYS, generate_batch, to_wire
     from featurenet_tpu.models import FeatureNet
     from featurenet_tpu.parallel.mesh import (
         batch_shardings,
@@ -68,7 +68,7 @@ def main() -> None:
 
     # The real classify wire format: bit-packed voxels, no per-voxel target,
     # unpacked on device inside the compiled step.
-    b_sh = batch_shardings(mesh, keys=("voxels", "label", "mask"))
+    b_sh = batch_shardings(mesh, keys=WIRE_KEYS["classify"])
     step = jax.jit(
         make_train_step(model, "classify", packed=True),
         in_shardings=(st_sh, b_sh, replicated(mesh)),

@@ -139,10 +139,12 @@ def _load_cache(cache_root: str):
 class VoxelCacheDataset:
     """Shuffled, host-sharded, infinite batch stream over a voxel cache.
 
-    Same contract as ``SyntheticVoxelDataset`` (``worker_iter`` / ``__iter__``
-    yielding ``{"voxels","label","seg"}``), so ``prefetch_to_device`` and the
-    Trainer work unchanged. ``split``: "train" or "test" — a deterministic
-    hash split per sample index (test_fraction of each class held out).
+    Emits the classify wire format (``data.synthetic.WIRE_KEYS["classify"]``):
+    ``voxels`` bit-packed uint8 ``[B, R, R, R/8]``, ``label`` int32, ``mask``
+    float32 — same contract as ``SyntheticVoxelDataset(task="classify")``, so
+    ``prefetch_to_device`` and the Trainer work unchanged. ``split``: "train"
+    or "test" — a deterministic hash split per sample index (test_fraction of
+    each class held out).
 
     ``augment=True`` applies a random rotation from the 24-element cube group
     to every sample drawn (train-time pose augmentation — the paper's ×24

@@ -356,14 +356,18 @@ def _f_h_circ_end_blind_slot(R, rng):
 
 
 def _f_six_sided_passage(R, rng):
-    r = _u(rng, 0.15, 0.3) * S
+    # Flat radius large enough that the hexagon's corners stand ~2+ voxels
+    # proud of the inscribed circle at 64³ — below that the feature is
+    # unresolvable from a round hole (measured: 49% of six-sided passages
+    # classified as through_hole at r≥0.15 before this floor was raised).
+    r = _u(rng, 0.22, 0.33) * S
     cx = _u(rng, LO + r * 1.2 + 0.05 * S, HI - r * 1.2 - 0.05 * S)
     cy = _u(rng, LO + r * 1.2 + 0.05 * S, HI - r * 1.2 - 0.05 * S)
     return _hex_prism_z(R, cx, cy, r, 0.0, 1.0)
 
 
 def _f_six_sided_pocket(R, rng):
-    r = _u(rng, 0.15, 0.3) * S
+    r = _u(rng, 0.22, 0.33) * S  # resolvable hex flats — see passage note
     d = _u(rng, 0.25, 0.6) * S
     cx = _u(rng, LO + r * 1.2 + 0.05 * S, HI - r * 1.2 - 0.05 * S)
     cy = _u(rng, LO + r * 1.2 + 0.05 * S, HI - r * 1.2 - 0.05 * S)
@@ -512,6 +516,14 @@ def pack_voxels(voxels: np.ndarray) -> np.ndarray:
     if voxels.shape[-1] % 8:
         raise ValueError(f"W={voxels.shape[-1]} not divisible by 8")
     return np.packbits(voxels.astype(bool), axis=-1)
+
+
+# Keys of each task's wire dict — the single source of truth shared by
+# to_wire, the Trainer's batch shardings, and bench.py.
+WIRE_KEYS = {
+    "classify": ("voxels", "label", "mask"),
+    "segment": ("voxels", "seg", "mask"),
+}
 
 
 def to_wire(batch: dict[str, np.ndarray], task: str) -> dict[str, np.ndarray]:

@@ -98,12 +98,10 @@ class Trainer:
         # voxels + int8 seg. Host→device bandwidth is the input pipeline's
         # scarce resource — 32x less of it than float32 batches.
         packed = cfg.task == "classify"
-        wire_keys = (
-            ("voxels", "label", "mask") if packed
-            else ("voxels", "seg", "mask")
-        )
+        from featurenet_tpu.data.synthetic import WIRE_KEYS
+
         self.batch_sh = batch_shardings(
-            self.mesh, spatial=self.spatial, keys=wire_keys
+            self.mesh, spatial=self.spatial, keys=WIRE_KEYS[cfg.task]
         )
         rep = replicated(self.mesh)
         # Cache-backed classification augments on device (rotations inside

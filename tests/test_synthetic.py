@@ -65,3 +65,31 @@ def test_orientation_preserves_counts():
     p_plain, _, _ = generate_sample(r1, 16, label=1, orient=False)
     p_rot, _, _ = generate_sample(r2, 16, label=1, orient=True)
     assert p_plain.sum() == p_rot.sum()
+
+
+def test_wire_pack_unpack_roundtrip(rng):
+    """Classify wire format: pack on host, unpack on device, bit-exact."""
+    import numpy as np
+
+    from featurenet_tpu.data.synthetic import generate_batch, to_wire
+    from featurenet_tpu.train.steps import unpack_voxels
+
+    b = generate_batch(rng, 4, resolution=16)
+    wire = to_wire(b, "classify")
+    assert wire["voxels"].shape == (4, 16, 16, 2)
+    assert wire["voxels"].dtype == np.uint8
+    assert "seg" not in wire
+    un = np.asarray(unpack_voxels(wire["voxels"]))
+    np.testing.assert_array_equal(un, b["voxels"])
+
+
+def test_wire_segment_format(rng):
+    import numpy as np
+
+    from featurenet_tpu.data.synthetic import generate_batch, to_wire
+
+    b = generate_batch(rng, 2, resolution=16, num_features=2)
+    wire = to_wire(b, "segment")
+    assert wire["voxels"].dtype == np.uint8
+    assert wire["seg"].dtype == np.int8
+    np.testing.assert_array_equal(wire["seg"], b["seg"])  # ids fit int8
