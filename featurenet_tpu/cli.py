@@ -86,6 +86,14 @@ def main(argv=None) -> None:
     p_exp.add_argument("--per-class", type=int, default=1000)
     p_exp.add_argument("--resolution", type=int, default=64)
     p_exp.add_argument("--seed", type=int, default=0)
+    p_seg = sub.add_parser("export-seg-data",
+                           help="materialize multi-feature parts with "
+                                "per-voxel ground truth as a seg cache")
+    p_seg.add_argument("--out", required=True)
+    p_seg.add_argument("--num-parts", type=int, default=2400)
+    p_seg.add_argument("--resolution", type=int, default=64)
+    p_seg.add_argument("--num-features", type=int, default=3)
+    p_seg.add_argument("--seed", type=int, default=0)
     p_bld = sub.add_parser("build-cache",
                            help="voxelize an STL class tree into an npz cache")
     p_bld.add_argument("--stl-root", required=True)
@@ -119,6 +127,19 @@ def main(argv=None) -> None:
             resolution=args.resolution, seed=args.seed,
         )
         print(json.dumps({"exported": index["counts"]}))
+        return
+    if args.cmd == "export-seg-data":
+        from featurenet_tpu.data.offline import export_seg_cache
+
+        index = export_seg_cache(
+            args.out, num_parts=args.num_parts,
+            resolution=args.resolution, num_features=args.num_features,
+            seed=args.seed,
+        )
+        print(json.dumps({
+            "exported": sum(s["count"] for s in index["shards"]),
+            "shards": len(index["shards"]),
+        }))
         return
     if args.cmd == "build-cache":
         from featurenet_tpu.data.offline import build_cache

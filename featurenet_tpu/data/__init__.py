@@ -16,8 +16,10 @@ from featurenet_tpu.data.dataset import (
     put_batch,
 )
 from featurenet_tpu.data.offline import (
+    SegCacheDataset,
     VoxelCacheDataset,
     build_cache,
+    export_seg_cache,
     export_synthetic_cache,
 )
 
@@ -35,7 +37,9 @@ __all__ = [
     "SyntheticVoxelDataset",
     "prefetch_to_device",
     "put_batch",
+    "SegCacheDataset",
     "VoxelCacheDataset",
     "build_cache",
+    "export_seg_cache",
     "export_synthetic_cache",
 ]

@@ -114,6 +114,56 @@ def export_synthetic_cache(
     return index
 
 
+def export_seg_cache(
+    out_root: str,
+    num_parts: int = 2400,
+    resolution: int = 64,
+    num_features: int = 3,
+    shard_size: int = 200,
+    seed: int = 0,
+) -> dict:
+    """Materialize multi-feature parts with per-voxel ground truth.
+
+    Segmentation parts carry several features each, so the per-class shard
+    layout of the classification cache doesn't apply; shards are flat
+    ``seg_{i:04d}.npz`` files holding ``voxels uint8 [N,R,R,R]`` and
+    ``seg int8 [N,R,R,R]`` (0 = stock/air, 1+class = feature removal
+    volume). ``index.json`` carries ``{"kind": "segment"}`` so the reader
+    picks the right dataset class.
+    """
+    os.makedirs(out_root, exist_ok=True)
+    index = {
+        "kind": "segment",
+        "resolution": resolution,
+        "num_features": num_features,
+        "shards": [],
+        "seed": seed,
+    }
+    done = 0
+    shard_id = 0
+    while done < num_parts:
+        n = min(shard_size, num_parts - done)
+        rng = np.random.default_rng(np.random.SeedSequence([seed, shard_id]))
+        voxels = np.zeros((n, resolution, resolution, resolution), np.uint8)
+        seg = np.zeros((n, resolution, resolution, resolution), np.int8)
+        for i in range(n):
+            part, _, s = generate_sample(
+                rng, resolution, num_features=num_features
+            )
+            voxels[i] = part.astype(np.uint8)
+            seg[i] = s.astype(np.int8)
+        name = f"seg_{shard_id:04d}.npz"
+        np.savez_compressed(
+            os.path.join(out_root, name), voxels=voxels, seg=seg
+        )
+        index["shards"].append({"file": name, "count": n})
+        done += n
+        shard_id += 1
+    with open(os.path.join(out_root, "index.json"), "w") as fh:
+        json.dump(index, fh, indent=1)
+    return index
+
+
 # One decompression per (cache dir, index mtime) per process: the Trainer
 # builds train+test instances over the same cache, and both index into the
 # memo's per-class arrays — no dataset-private copy of the grids exists, so
@@ -127,6 +177,11 @@ def _load_cache(cache_root: str):
     if key not in _cache_memo:
         with open(index_path) as fh:
             index = json.load(fh)
+        if index.get("kind") == "segment":
+            raise ValueError(
+                f"{cache_root} is a segmentation cache; use it with "
+                "task='segment' (SegCacheDataset), not a classify config"
+            )
         grids = {}
         for cls in index["classes"]:
             with np.load(os.path.join(cache_root, f"{cls}.npz")) as z:
@@ -134,6 +189,129 @@ def _load_cache(cache_root: str):
         _cache_memo.clear()  # hold at most one cache resident
         _cache_memo[key] = (index, grids)
     return _cache_memo[key]
+
+
+def _hash_split_rows(n: int, split: str, test_fraction: float) -> np.ndarray:
+    """Deterministic per-index hash split, shared by both cache datasets:
+    the same samples are held out regardless of host count or epoch."""
+    h = (np.arange(n) * 2654435761 % 1000) / 1000.0
+    keep = h >= test_fraction if split == "train" else h < test_fraction
+    return np.nonzero(keep)[0].astype(np.int64)
+
+
+def _epoch_index_batches(n: int, batch: int):
+    """Exact-pass index batches; the final partial batch wraps to the front
+    with mask=0 rows so masked sums count every sample exactly once while
+    batch shapes stay static. Shared by both cache datasets."""
+    for start in range(0, n, batch):
+        idx = np.arange(start, min(start + batch, n))
+        mask = np.ones(batch, dtype=np.float32)
+        if len(idx) < batch:
+            mask[len(idx):] = 0.0
+            pad = np.arange(batch - len(idx)) % n
+            idx = np.concatenate([idx, pad])
+        yield idx, mask
+
+
+def _load_seg_cache(cache_root: str):
+    index_path = os.path.join(cache_root, "index.json")
+    key = ("seg", os.path.abspath(cache_root), os.path.getmtime(index_path))
+    if key not in _cache_memo:
+        with open(index_path) as fh:
+            index = json.load(fh)
+        if index.get("kind") != "segment":
+            raise ValueError(
+                f"{cache_root} is not a segmentation cache (export with "
+                "export_seg_cache / `cli export-seg-data`)"
+            )
+        voxels, seg = [], []
+        for sh in index["shards"]:
+            with np.load(os.path.join(cache_root, sh["file"])) as z:
+                voxels.append(z["voxels"])
+                seg.append(z["seg"])
+        _cache_memo.clear()  # hold at most one cache resident
+        _cache_memo[key] = (index, np.concatenate(voxels), np.concatenate(seg))
+    return _cache_memo[key]
+
+
+class SegCacheDataset:
+    """Shuffled, host-sharded stream over a segmentation cache.
+
+    Emits the segment wire format (``data.synthetic.WIRE_KEYS["segment"]``):
+    ``voxels`` uint8 ``[B,R,R,R,1]``, ``seg`` int8 ``[B,R,R,R]``, ``mask``.
+    ``augment=True`` applies one cube-group rotation per sample to voxels
+    and seg jointly (per-voxel targets must rotate with the part, so the
+    device-side classify augmentation does not apply here). ``split`` uses
+    the same deterministic index-hash rule as ``VoxelCacheDataset``.
+    """
+
+    def __init__(
+        self,
+        cache_root: str,
+        global_batch: int = 32,
+        split: str = "train",
+        test_fraction: float = 0.2,
+        num_hosts: int = 1,
+        host_id: int = 0,
+        seed: int = 0,
+        augment: bool = False,
+    ):
+        if global_batch % num_hosts != 0:
+            raise ValueError("global_batch must divide evenly across hosts")
+        self.index, self._voxels, self._seg = _load_seg_cache(cache_root)
+        self.resolution = int(self.index["resolution"])
+        self.global_batch = global_batch
+        self.local_batch = global_batch // num_hosts
+        self.seed = seed
+        self.host_id = host_id
+        self.augment = augment
+        self.rows = _hash_split_rows(
+            self._voxels.shape[0], split, test_fraction
+        )
+        if len(self.rows) == 0:
+            raise ValueError(f"empty split {split!r} in {cache_root}")
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def _gather(self, idx, rng=None):
+        voxels, seg = [], []
+        for m in idx:
+            v = self._voxels[self.rows[m]]
+            s = self._seg[self.rows[m]]
+            if rng is not None:
+                rot = random_orientation(rng)
+                v, s = rot(v), rot(s)
+            voxels.append(v)
+            seg.append(s)
+        return (
+            np.stack(voxels)[..., None].astype(np.uint8),
+            np.stack(seg).astype(np.int8),
+        )
+
+    def worker_iter(self, worker_id: int = 0, num_workers: int = 1
+                    ) -> Iterator[dict[str, np.ndarray]]:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.host_id, worker_id])
+        )
+        n = len(self.rows)
+        while True:
+            idx = rng.integers(0, n, size=self.local_batch)
+            v, s = self._gather(idx, rng if self.augment else None)
+            yield {
+                "voxels": v,
+                "seg": s,
+                "mask": np.ones(self.local_batch, dtype=np.float32),
+            }
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return self.worker_iter(0, 1)
+
+    def epoch_batches(self, batch: int) -> Iterator[dict[str, np.ndarray]]:
+        """One exact pass; the final partial batch wraps with mask=0 rows."""
+        for idx, mask in _epoch_index_batches(len(self.rows), batch):
+            v, s = self._gather(idx)
+            yield {"voxels": v, "seg": s, "mask": mask}
 
 
 class VoxelCacheDataset:
@@ -181,12 +359,9 @@ class VoxelCacheDataset:
         rows, labels = [], []
         for cls_id, cls in enumerate(self.index["classes"]):
             n = self._grids[cls_id].shape[0]
-            # Deterministic split: the same samples are held out regardless
-            # of host count or epoch (index-hash, not RNG order).
-            h = (np.arange(n) * 2654435761 % 1000) / 1000.0
-            keep = h >= test_fraction if split == "train" else h < test_fraction
-            rows.append(np.nonzero(keep)[0].astype(np.int64))
-            labels.append(np.full(keep.sum(), cls_id, dtype=np.int32))
+            r = _hash_split_rows(n, split, test_fraction)
+            rows.append(r)
+            labels.append(np.full(len(r), cls_id, dtype=np.int32))
         self.rows = np.concatenate(rows)
         self.labels = np.concatenate(labels)
         if len(self.labels) == 0:
@@ -237,14 +412,7 @@ class VoxelCacheDataset:
         ``mask=0`` rows, so downstream masked sums count each held-out
         sample exactly once while batch shapes stay static.
         """
-        n = len(self.labels)
-        for s in range(0, n, batch):
-            idx = np.arange(s, min(s + batch, n))
-            mask = np.ones(batch, dtype=np.float32)
-            if len(idx) < batch:
-                mask[len(idx):] = 0.0
-                pad = np.arange(batch - len(idx)) % n  # wrap, split may be < batch
-                idx = np.concatenate([idx, pad])
+        for idx, mask in _epoch_index_batches(len(self.labels), batch):
             yield {
                 "voxels": self._gather(idx),
                 "label": self.labels[idx],

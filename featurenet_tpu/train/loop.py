@@ -136,12 +136,23 @@ class Trainer:
         # batch (the DistributedSampler analog); put_batch then assembles
         # the globally-sharded array from per-host slices.
         n_hosts, host_id = jax.process_count(), jax.process_index()
-        if cfg.data_cache:
-            if cfg.task == "segment":
-                raise ValueError(
-                    "data_cache stores no per-voxel ground truth (seg is "
-                    "all-zeros); task='segment' requires synthetic data"
-                )
+        if cfg.data_cache and cfg.task == "segment":
+            from featurenet_tpu.data.offline import SegCacheDataset
+
+            common = dict(
+                global_batch=cfg.global_batch,
+                test_fraction=cfg.test_fraction,
+                num_hosts=n_hosts,
+                host_id=host_id,
+            )
+            self.train_data = SegCacheDataset(
+                cfg.data_cache, split="train", seed=cfg.seed,
+                augment=cfg.augment, **common,
+            )
+            self.eval_data = SegCacheDataset(
+                cfg.data_cache, split="test", seed=cfg.seed + 10_000, **common,
+            )
+        elif cfg.data_cache:
             from featurenet_tpu.data.offline import VoxelCacheDataset
 
             self.train_data = VoxelCacheDataset(
