@@ -101,13 +101,11 @@ class Config:
                 "enabled (use augment=False or augment_device=False to "
                 "disable augmentation)"
             )
-        if self.task == "classify" and self.resolution % 8:
+        if self.resolution % 8:
             raise ValueError(
-                "classify: resolution must be divisible by 8 (the wire "
-                "format bit-packs voxels along the W axis)"
+                "resolution must be divisible by 8 (the wire format "
+                "bit-packs voxels along the W axis)"
             )
-        if self.resolution % 2:
-            raise ValueError("resolution must be even")
         if self.task == "segment":
             down = 2 ** len(self.seg_features)
             if self.resolution % down:

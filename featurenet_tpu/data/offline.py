@@ -33,6 +33,7 @@ from featurenet_tpu.data.stl import load_stl
 from featurenet_tpu.data.synthetic import (
     CLASS_NAMES,
     generate_sample,
+    pack_voxels,
     random_orientation,
 )
 from featurenet_tpu.data.voxelize import voxelize
@@ -46,6 +47,8 @@ def build_cache(
     backend: str = "auto",
 ) -> dict:
     """Voxelize an STL class tree into npz shards. Returns the index dict."""
+    if resolution % 8:
+        raise ValueError("resolution must be divisible by 8 (packed wire)")
     os.makedirs(out_root, exist_ok=True)
     classes = list(classes) if classes is not None else sorted(
         d for d in os.listdir(stl_root)
@@ -88,6 +91,8 @@ def export_synthetic_cache(
     train/test split downstream — the on-disk analog of the reference's
     24 × 1000 benchmark.
     """
+    if resolution % 8:
+        raise ValueError("resolution must be divisible by 8 (packed wire)")
     os.makedirs(out_root, exist_ok=True)
     index = {"resolution": resolution, "classes": [], "counts": {}, "seed": seed}
     for cls_id, cls in enumerate(CLASS_NAMES):
@@ -131,6 +136,8 @@ def export_seg_cache(
     volume). ``index.json`` carries ``{"kind": "segment"}`` so the reader
     picks the right dataset class.
     """
+    if resolution % 8:
+        raise ValueError("resolution must be divisible by 8 (packed wire)")
     os.makedirs(out_root, exist_ok=True)
     index = {
         "kind": "segment",
@@ -238,11 +245,12 @@ class SegCacheDataset:
     """Shuffled, host-sharded stream over a segmentation cache.
 
     Emits the segment wire format (``data.synthetic.WIRE_KEYS["segment"]``):
-    ``voxels`` uint8 ``[B,R,R,R,1]``, ``seg`` int8 ``[B,R,R,R]``, ``mask``.
-    ``augment=True`` applies one cube-group rotation per sample to voxels
-    and seg jointly (per-voxel targets must rotate with the part, so the
-    device-side classify augmentation does not apply here). ``split`` uses
-    the same deterministic index-hash rule as ``VoxelCacheDataset``.
+    ``voxels`` bit-packed uint8 ``[B,R,R,R/8]``, ``seg`` int8 ``[B,R,R,R]``,
+    ``mask``. ``augment=True`` applies one cube-group rotation per sample to
+    voxels and seg jointly, before packing (per-voxel targets must rotate
+    with the part, so the device-side classify augmentation does not apply
+    here). ``split`` uses the same deterministic index-hash rule as
+    ``VoxelCacheDataset``.
     """
 
     def __init__(
@@ -282,12 +290,9 @@ class SegCacheDataset:
             if rng is not None:
                 rot = random_orientation(rng)
                 v, s = rot(v), rot(s)
-            voxels.append(v)
+            voxels.append(pack_voxels(v))  # validates W % 8
             seg.append(s)
-        return (
-            np.stack(voxels)[..., None].astype(np.uint8),
-            np.stack(seg).astype(np.int8),
-        )
+        return np.stack(voxels), np.stack(seg).astype(np.int8)
 
     def worker_iter(self, worker_id: int = 0, num_workers: int = 1
                     ) -> Iterator[dict[str, np.ndarray]]:
@@ -380,7 +385,7 @@ class VoxelCacheDataset:
             g = self._grids[self.labels[m]][self.rows[m]]
             if rng is not None:
                 g = random_orientation(rng)(g)
-            samples.append(np.packbits(g.astype(bool), axis=-1))
+            samples.append(pack_voxels(g))  # validates W % 8
         return np.stack(samples)
 
     def __len__(self) -> int:

@@ -529,8 +529,10 @@ WIRE_KEYS = {
 def to_wire(batch: dict[str, np.ndarray], task: str) -> dict[str, np.ndarray]:
     """Shrink a rich ``generate_batch`` dict to the per-task wire format.
 
-    classify: packed voxels + label + mask (no per-voxel target travels).
-    segment:  uint8 voxels + int8 seg + mask (class ids fit int8).
+    Voxels are bit-packed for both tasks (the occupancy grid is binary
+    either way; the jitted step unpacks on device). classify additionally
+    drops the per-voxel target; segment ships ``seg`` as int8 (class ids
+    fit comfortably).
     """
     if task == "classify":
         return {
@@ -539,11 +541,8 @@ def to_wire(batch: dict[str, np.ndarray], task: str) -> dict[str, np.ndarray]:
             "mask": batch["mask"],
         }
     if task == "segment":
-        v = batch["voxels"]
-        if v.ndim == 4:
-            v = v[..., None]
         return {
-            "voxels": v.astype(np.uint8),
+            "voxels": pack_voxels(batch["voxels"]),
             "seg": batch["seg"].astype(np.int8),
             "mask": batch["mask"],
         }

@@ -93,11 +93,11 @@ class Trainer:
         self.params_n = param_count(self.state.params)
 
         # --- compiled steps -------------------------------------------------
-        # Wire format: classify ships bit-packed voxels and no per-voxel
-        # target (unpacked on device inside the step); segment ships uint8
-        # voxels + int8 seg. Host→device bandwidth is the input pipeline's
-        # scarce resource — 32x less of it than float32 batches.
-        packed = cfg.task == "classify"
+        # Wire format: voxels travel bit-packed for both tasks (unpacked on
+        # device inside the step); classify drops the per-voxel target,
+        # segment ships int8 seg. Host→device bandwidth is the input
+        # pipeline's scarce resource — 32x less of it than float32 batches.
+        packed = True
         from featurenet_tpu.data.synthetic import WIRE_KEYS
 
         self.batch_sh = batch_shardings(

@@ -164,7 +164,7 @@ def test_seg_cache_roundtrip_and_dataset(tmp_path):
     assert sum(s["count"] for s in index["shards"]) == 24
     ds = SegCacheDataset(out, global_batch=8, split="train", test_fraction=0.25)
     b = next(iter(ds))
-    assert b["voxels"].shape == (8, 16, 16, 16, 1)
+    assert b["voxels"].shape == (8, 16, 16, 2)  # bit-packed wire
     assert b["voxels"].dtype == np.uint8
     assert b["seg"].shape == (8, 16, 16, 16)
     assert b["seg"].dtype == np.int8
@@ -175,7 +175,8 @@ def test_seg_cache_roundtrip_and_dataset(tmp_path):
     aug = SegCacheDataset(out, global_batch=8, split="train",
                           test_fraction=0.25, augment=True, seed=9)
     ba = next(iter(aug))
-    assert not np.any((ba["seg"] > 0) & (ba["voxels"][..., 0] > 0))
+    unpacked = np.unpackbits(ba["voxels"], axis=-1)
+    assert not np.any((ba["seg"] > 0) & (unpacked > 0))
     # Splits are disjoint and complete.
     te = SegCacheDataset(out, global_batch=8, split="test", test_fraction=0.25)
     assert len(ds) + len(te) == 24

@@ -87,9 +87,14 @@ def test_wire_segment_format(rng):
     import numpy as np
 
     from featurenet_tpu.data.synthetic import generate_batch, to_wire
+    from featurenet_tpu.train.steps import unpack_voxels
 
     b = generate_batch(rng, 2, resolution=16, num_features=2)
     wire = to_wire(b, "segment")
     assert wire["voxels"].dtype == np.uint8
+    assert wire["voxels"].shape == (2, 16, 16, 2)  # bit-packed
+    np.testing.assert_array_equal(
+        np.asarray(unpack_voxels(wire["voxels"])), b["voxels"]
+    )
     assert wire["seg"].dtype == np.int8
     np.testing.assert_array_equal(wire["seg"], b["seg"])  # ids fit int8
