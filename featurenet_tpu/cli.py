@@ -47,13 +47,35 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="jax_debug_nans: fail fast on the op producing a NaN")
 
 
+def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
+    # Train-only (a supervised eval would parse but silently not supervise).
+    p.add_argument("--heartbeat-file",
+                   help="touch this file at each confirmed point of device "
+                        "progress (used by --supervise; standalone use lets "
+                        "external monitoring watch run liveness)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run training under a stall supervisor: restart from "
+                        "the latest checkpoint when the heartbeat goes stale "
+                        "(hung device/tunnel) or the process crashes; "
+                        "requires --checkpoint-dir")
+    p.add_argument("--stall-timeout", type=float, default=600.0,
+                   help="seconds of heartbeat staleness that count as a hang "
+                        "(default 600)")
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="restarts allowed before the supervisor gives up")
+
+
 def _overrides(args) -> dict:
     keys = [
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
-        "profile_dir", "tb_dir",
+        "profile_dir", "tb_dir", "heartbeat_file",
     ]
-    out = {k: getattr(args, k) for k in keys if getattr(args, k) is not None}
+    out = {
+        k: getattr(args, k, None)
+        for k in keys
+        if getattr(args, k, None) is not None
+    }
     if getattr(args, "no_augment", False):
         out["augment"] = False
     return out
@@ -73,12 +95,17 @@ def _apply_arch_overrides(cfg, args):
 
 
 def main(argv=None) -> None:
-    parser = argparse.ArgumentParser(prog="featurenet_tpu")
+    # allow_abbrev=False everywhere: the supervisor re-execs a rewritten argv
+    # with supervision flags stripped by exact match — a prefix abbreviation
+    # like --superv would leak through and spawn supervisors recursively.
+    parser = argparse.ArgumentParser(prog="featurenet_tpu", allow_abbrev=False)
     parser.add_argument("--distributed", action="store_true",
                         help="multi-host: jax.distributed.initialize() first")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    _add_override_flags(sub.add_parser("train"))
-    _add_override_flags(sub.add_parser("eval"))
+    p_train = sub.add_parser("train", allow_abbrev=False)
+    _add_override_flags(p_train)
+    _add_supervise_flags(p_train)
+    _add_override_flags(sub.add_parser("eval", allow_abbrev=False))
     sub.add_parser("bench")
     p_exp = sub.add_parser("export-data",
                            help="materialize the synthetic set as an npz cache")
@@ -108,6 +135,44 @@ def main(argv=None) -> None:
                        help="must match the trained checkpoint's resolution "
                             "when the run overrode the preset")
     args = parser.parse_args(argv)
+
+    if args.cmd == "train" and getattr(args, "supervise", False):
+        import os
+        import sys
+        import tempfile
+
+        from featurenet_tpu.train.supervisor import (
+            child_argv_from_cli,
+            supervise,
+        )
+
+        if not args.checkpoint_dir:
+            raise SystemExit(
+                "--supervise requires --checkpoint-dir: a restarted run "
+                "must resume, not silently retrain from scratch"
+            )
+        # Honor a user-supplied heartbeat path (external monitoring may be
+        # watching it); otherwise use a private temp file, removed on exit.
+        hb, hb_is_temp = args.heartbeat_file, False
+        if not hb:
+            fd, hb = tempfile.mkstemp(prefix="fn_heartbeat_")
+            os.close(fd)
+            hb_is_temp = True
+        raw = argv if argv is not None else sys.argv[1:]
+        try:
+            result = supervise(
+                child_argv_from_cli(raw, hb),
+                stall_timeout_s=args.stall_timeout,
+                max_restarts=args.max_restarts,
+                heartbeat_file=hb,
+            )
+        finally:
+            if hb_is_temp:
+                try:
+                    os.unlink(hb)
+                except OSError:
+                    pass
+        raise SystemExit(result.exit_code)
 
     if args.distributed:
         import jax

@@ -86,6 +86,12 @@ class Config:
     # Logging / checkpointing. tb_dir: also mirror scalar metrics to
     # TensorBoard event files (CLU metric_writers).
     tb_dir: Optional[str] = None
+    # Liveness: when set, the Trainer touches this file at every confirmed
+    # point of progress (a device readback, an eval, a checkpoint). A
+    # supervisor (train.supervisor / `cli train --supervise`) watches the
+    # mtime to detect stalled runs — e.g. a hung device tunnel — and
+    # restarts from the latest checkpoint.
+    heartbeat_file: Optional[str] = None
     log_every: int = 50
     eval_every: int = 500
     checkpoint_every: int = 500

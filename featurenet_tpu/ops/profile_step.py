@@ -1,0 +1,201 @@
+"""Layer-wise timing breakdown of the pod64 train step.
+
+Run on TPU:  python -m featurenet_tpu.ops.profile_step [--batch 128]
+
+Answers "where do the milliseconds of the flagship step go" without XProf
+(the tunneled backend exposes no trace viewer): slope-times, at the pod64
+shapes, (a) prefix stacks of the conv tower forward, (b) the full forward,
+(c) the full fwd+bwd, and (d) the complete train step (fwd+bwd+opt+BN+
+unpack). Differences between consecutive prefixes attribute forward time to
+individual blocks; (c)-(b) is the backward cost; (d)-(c) is optimizer +
+wire-unpack + augmentation overhead. Results drive backend defaults the same
+way `ops/bench_ops.py` does (BASELINE.md).
+
+Timing method matches bench_ops: each measured fn is dispatched as one
+compiled call; wall = time to a device->host readback of a scalar derived
+from the output (block_until_ready returns early through the tunnel); the
+(2N-N)/N slope subtracts the constant round-trip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+
+def _wall(fn, args, repeats: int = 5) -> float:
+    """Best-of-N wall seconds for one dispatch of ``fn`` + scalar readback."""
+    import jax.numpy as jnp
+
+    out = fn(*args)  # compile + warm
+    leaf = out[0] if isinstance(out, tuple) else out
+    float(jnp.sum(leaf) if leaf.ndim else leaf)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        leaf = out[0] if isinstance(out, tuple) else out
+        float(jnp.sum(leaf) if leaf.ndim else leaf)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _scan_time(step_fn, init_carry, iters: int = 24) -> float:
+    """Per-iteration seconds of ``carry -> carry`` via scan slope timing."""
+    import jax
+
+    def chained(n):
+        def run(c):
+            out, _ = jax.lax.scan(lambda c, _: (step_fn(c), ()), c, None,
+                                  length=n)
+            return out
+        return jax.jit(run)
+
+    t1 = _wall(chained(iters), (init_carry,))
+    t2 = _wall(chained(2 * iters), (init_carry,))
+    return (t2 - t1) / iters
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=128)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.data.synthetic import WIRE_KEYS, generate_batch, to_wire
+    from featurenet_tpu.models import FeatureNet
+    from featurenet_tpu.models.featurenet import FeatureNetArch
+    from featurenet_tpu.train.state import create_state
+    from featurenet_tpu.train.steps import make_optimizer, make_train_step
+
+    cfg = get_config("pod64")
+    B, R = args.batch, cfg.resolution
+    rng = np.random.default_rng(0)
+    voxels = jnp.asarray(rng.random((B, R, R, R, 1)) < 0.5, jnp.float32)
+    rows = []
+
+    def record(name, sec, flops=None):
+        row = {"metric": name, "value": round(sec * 1e3, 3), "unit": "ms"}
+        if flops:
+            row["tflops"] = round(flops / sec / 1e12, 1)
+        rows.append(row)
+        print(json.dumps(row))
+
+    # --- (a) forward prefix stacks: attribute fwd time per conv block -------
+    # Tower-only prefixes (no flatten/Dense head — on a truncated stack the
+    # head would flatten a huge activation and dominate the measurement).
+    from flax import linen as nn
+
+    from featurenet_tpu.models.featurenet import ConvBNRelu
+
+    a = cfg.arch
+
+    class Tower(nn.Module):
+        arch: FeatureNetArch
+        blocks: int
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            t = self.arch
+            x = x.astype(jnp.bfloat16)
+            for f, k_, s, p in list(
+                zip(t.features, t.kernels, t.strides, t.pool_after)
+            )[: self.blocks]:
+                x = ConvBNRelu(f, k_, s, p, stem_s2d=t.stem_s2d,
+                               conv_backend=t.conv_backend)(x, train)
+            return x
+
+    prev = 0.0
+    spatial = R
+    flops_prefix = 0.0
+    for k in range(1, len(a.features) + 1):
+        spatial //= a.strides[k - 1]  # output spatial of this block
+        cin = 1 if k == 1 else a.features[k - 2]
+        flops_prefix += (
+            2 * B * spatial**3 * a.kernels[k - 1] ** 3 * cin * a.features[k - 1]
+        )
+        if a.pool_after[k - 1]:
+            spatial //= 2
+
+        model_k = Tower(arch=a, blocks=k)
+        vs = model_k.init({"params": jax.random.key(0)}, voxels, train=False)
+
+        def fwd_sum(c, _m=model_k, _vs=vs):
+            y = _m.apply(_vs, voxels, train=False)
+            return c + jnp.sum(y).astype(c.dtype) * 1e-12
+
+        t = _scan_time(fwd_sum, jnp.zeros((), jnp.float32))
+        record(f"fwd_prefix_{k}blocks", t, flops_prefix)
+        record(f"fwd_block_{k}_delta", t - prev)
+        prev = t
+
+    # --- (b,c) full forward vs fwd+bwd --------------------------------------
+    model = FeatureNet(arch=a)
+    variables = model.init({"params": jax.random.key(0)}, voxels, train=False)
+    params = variables["params"]
+    batch_stats = variables["batch_stats"]
+    labels = jnp.asarray(rng.integers(0, a.num_classes, B), jnp.int32)
+    drng = jax.random.key(1)
+
+    def loss_fn(params, bs):
+        import optax
+
+        logits, new_vars = model.apply(
+            {"params": params, "batch_stats": bs}, voxels, train=True,
+            mutable=["batch_stats"], rngs={"dropout": drng},
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean(), new_vars
+
+    t_fwd = _scan_time(
+        lambda c: c + loss_fn(params, batch_stats)[0] * 1e-12,
+        jnp.zeros((), jnp.float32),
+    )
+    record("full_fwd_train", t_fwd)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def fwdbwd(c):
+        (loss, _), grads = grad_fn(params, batch_stats)
+        return c + (loss + jax.tree_util.tree_reduce(
+            lambda x, y: x + jnp.sum(y).astype(jnp.float32), grads, 0.0
+        )) * 1e-12
+
+    t_fb = _scan_time(fwdbwd, jnp.zeros((), jnp.float32))
+    record("full_fwd_bwd", t_fb)
+    record("bwd_delta", t_fb - t_fwd)
+
+    # --- (d) complete train step (unpack+augment+opt included) --------------
+    tx = make_optimizer(cfg)
+    state = create_state(model, tx, voxels, jax.random.key(0))
+    wire = to_wire(generate_batch(rng, B, R), "classify")
+    batch = {k: jnp.asarray(v) for k, v in wire.items()}
+    step = jax.jit(make_train_step(model, "classify", packed=True),
+                   donate_argnums=(0,))
+    key = jax.random.key(2)
+
+    state, m = step(state, batch, key)  # compile
+    float(m["loss"])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            state, m = step(state, batch, key)
+        float(m["loss"])
+        best = min(best, (time.perf_counter() - t0) / 10)
+    record("train_step_total_incl_dispatch", best)
+    record("overhead_opt_unpack_aug_dispatch", best - t_fb)
+
+    print(json.dumps({"summary": rows}))
+
+
+if __name__ == "__main__":
+    main()

@@ -199,6 +199,20 @@ class Trainer:
         if cfg.checkpoint_dir:
             self.ckpt = CheckpointManager(cfg.checkpoint_dir, cfg.keep_checkpoints)
 
+    def _heartbeat(self) -> None:
+        """Record confirmed progress for an external supervisor.
+
+        Called only after evidence the *device* is advancing (a completed
+        readback / eval / checkpoint) — never on mere dispatch, which
+        succeeds even when the backend is hung.
+        """
+        hb = self.cfg.heartbeat_file
+        if hb:
+            import os
+
+            with open(hb, "a"):
+                os.utime(hb, None)
+
     # ------------------------------------------------------------------
     def resume_if_available(self) -> int:
         if self.ckpt and self.ckpt.latest_step() is not None:
@@ -258,6 +272,7 @@ class Trainer:
                 pending.append(metrics["loss"])
                 if len(pending) > max(cfg.max_inflight_steps, 1):
                     float(pending.popleft())  # readback = proof of progress
+                    self._heartbeat()
                 if trace_active and (
                     step + 1 >= trace_start + cfg.profile_steps
                     or step + 1 == total
@@ -279,9 +294,11 @@ class Trainer:
                     last = {**last, **{f"eval_{k}": v for k, v in ev.items()}}
                     # Don't charge eval wall time to the next train window.
                     self.logger.start_window()
+                    self._heartbeat()
                 if self.ckpt and ((step + 1) % cfg.checkpoint_every == 0
                                   or step + 1 == total):
                     self.ckpt.save(self.state)
+                    self._heartbeat()
         finally:
             if trace_active:
                 # An exception mid-window must not lose the trace of the

@@ -1,0 +1,157 @@
+"""Stall detection + auto-restart for training runs (failure recovery).
+
+The reference has no failure handling at all — a hung NCCL collective or a
+dead rank freezes the job until someone notices (SURVEY.md §5 "failure
+detection"). The TPU-native rebuild keeps the same fail-fast *device* posture
+(no elastic resharding — a classifier never needs it) but adds the piece that
+actually bites in practice: a **supervisor process** that watches a heartbeat
+file the Trainer touches at every confirmed point of device progress, and
+kills + restarts the training process from its latest Orbax checkpoint when
+the heartbeat goes stale or the process dies.
+
+Why a separate process: a stalled step is a thread blocked inside the runtime
+waiting on the device transport (observed here: a hung tunnel read parks the
+main thread in a futex with signals undeliverable). No in-process watchdog
+can interrupt that reliably — only SIGKILL from outside can. This is the
+moral equivalent of torchrun's elastic agent, reduced to the single-node
+fail-fast case.
+
+Used via ``python -m featurenet_tpu.cli train --supervise [...]``; the
+supervisor re-execs the identical CLI command minus the supervision flags,
+plus ``--heartbeat-file``. Requires ``--checkpoint-dir`` (restart without
+resume would silently retrain from scratch — refused).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class SuperviseResult:
+    exit_code: int  # final child exit code (0 = success)
+    restarts: int  # how many times the child was restarted
+    stalls: int  # how many restarts were due to a stale heartbeat
+
+
+def _kill_tree(proc: subprocess.Popen) -> None:
+    """SIGKILL the child's whole process group (it may own worker threads
+    blocked in native code; nothing softer is guaranteed to land)."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
+    proc.wait()
+
+
+def supervise(
+    argv: Sequence[str],
+    heartbeat_file: str,
+    stall_timeout_s: float = 600.0,
+    max_restarts: int = 5,
+    poll_s: float = 5.0,
+    grace_s: Optional[float] = None,
+    log=print,
+) -> SuperviseResult:
+    """Run ``argv`` under stall supervision; restart on stall or crash.
+
+    Args:
+      argv: full child command (e.g. ``[sys.executable, "-m",
+        "featurenet_tpu.cli", "train", ...]``) WITHOUT supervision flags but
+        WITH ``--heartbeat-file`` pointing at ``heartbeat_file``. (Required
+        and explicit because the caller builds argv: a path invented in here
+        could never be the one the child touches — a guaranteed kill-loop.)
+      heartbeat_file: path the child touches; refreshed before each spawn.
+      stall_timeout_s: heartbeat staleness that counts as a hang.
+      max_restarts: restarts allowed before giving up (crash-looping run).
+      poll_s: supervisor polling interval.
+      grace_s: stall clock allowance for the child's cold start (compile can
+        dwarf a step); defaults to ``max(stall_timeout_s, 600)``.
+      log: sink for one-line JSON status records.
+
+    Returns a ``SuperviseResult``; ``exit_code`` 0 means the child finished.
+    """
+    grace = grace_s if grace_s is not None else max(stall_timeout_s, 600.0)
+
+    restarts = stalls = 0
+    while True:
+        # Fresh heartbeat so a stale file from the previous child can't
+        # trigger (or mask) a stall verdict for this one. Its mtime is the
+        # baseline: only a *newer* mtime proves the child itself beat, so
+        # the cold-start grace (compile >> step time) governs until then.
+        with open(heartbeat_file, "a"):
+            os.utime(heartbeat_file, None)
+        base_mtime = os.path.getmtime(heartbeat_file)
+        started = time.monotonic()
+        first_beat_seen = False
+        proc = subprocess.Popen(list(argv), start_new_session=True)
+        log(json.dumps({"supervisor": "spawn", "pid": proc.pid,
+                        "attempt": restarts + 1}))
+        stalled = False
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            time.sleep(poll_s)
+            mtime = os.path.getmtime(heartbeat_file)
+            age = time.time() - mtime
+            if not first_beat_seen:
+                if mtime > base_mtime:
+                    first_beat_seen = True  # child has produced a beat
+                elif time.monotonic() - started > grace:
+                    stalled = True  # never came up at all
+            elif age > stall_timeout_s:
+                stalled = True
+            if stalled:
+                log(json.dumps({
+                    "supervisor": "stall", "pid": proc.pid,
+                    "heartbeat_age_s": round(age, 1),
+                }))
+                _kill_tree(proc)
+                rc = proc.returncode
+                break
+        if not stalled and rc == 0:
+            log(json.dumps({"supervisor": "done", "restarts": restarts,
+                            "stalls": stalls}))
+            return SuperviseResult(0, restarts, stalls)
+        stalls += int(stalled)
+        restarts += 1
+        if restarts > max_restarts:
+            log(json.dumps({"supervisor": "giving_up", "restarts": restarts - 1,
+                            "stalls": stalls, "last_exit": rc}))
+            return SuperviseResult(rc if rc else 1, restarts - 1, stalls)
+        log(json.dumps({"supervisor": "restart", "attempt": restarts + 1,
+                        "reason": "stall" if stalled else f"exit_{rc}"}))
+
+
+def child_argv_from_cli(argv: Sequence[str], heartbeat_file: str) -> list[str]:
+    """Rewrite this process's CLI argv into the supervised child's argv:
+    strip supervision flags, inject the heartbeat path."""
+    out = [sys.executable, "-m", "featurenet_tpu.cli"]
+    skip_next = False
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a == "--supervise":
+            continue
+        if a in ("--stall-timeout", "--max-restarts", "--heartbeat-file"):
+            skip_next = True
+            continue
+        if a.startswith(
+            ("--stall-timeout=", "--max-restarts=", "--heartbeat-file=")
+        ):
+            continue
+        out.append(a)
+    out += ["--heartbeat-file", heartbeat_file]
+    return out

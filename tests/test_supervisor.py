@@ -1,0 +1,126 @@
+"""Failure-recovery supervisor: stall detection, crash restart, give-up.
+
+Children are tiny ``python -c`` scripts coordinating through files in
+tmp_path, so every scenario runs in seconds with no device and no Trainer.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from featurenet_tpu.train.supervisor import child_argv_from_cli, supervise
+
+
+def _child(code: str) -> list[str]:
+    return [sys.executable, "-c", code]
+
+
+def test_clean_exit_no_restart(tmp_path):
+    hb = tmp_path / "hb"
+    res = supervise(
+        _child("pass"),
+        stall_timeout_s=5,
+        max_restarts=2,
+        heartbeat_file=str(hb),
+        poll_s=0.1,
+        log=lambda _: None,
+    )
+    assert res.exit_code == 0
+    assert res.restarts == 0
+    assert res.stalls == 0
+
+
+def test_crash_then_success_restarts_once(tmp_path):
+    marker = tmp_path / "attempted"
+    code = (
+        "import os,sys\n"
+        f"m={str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m,'w').close(); sys.exit(3)\n"
+    )
+    res = supervise(
+        _child(code),
+        stall_timeout_s=5,
+        max_restarts=3,
+        heartbeat_file=str(tmp_path / "hb"),
+        poll_s=0.1,
+        log=lambda _: None,
+    )
+    assert res.exit_code == 0
+    assert res.restarts == 1
+    assert res.stalls == 0
+
+
+def test_stalled_child_is_killed_and_restarted(tmp_path):
+    marker = tmp_path / "attempted"
+    hb = tmp_path / "hb"
+    # Attempt 1: beat once, then hang far past the stall timeout.
+    # Attempt 2: beat and exit cleanly.
+    code = (
+        "import os,time\n"
+        f"m={str(marker)!r}; hb={str(hb)!r}\n"
+        "os.utime(hb, None)\n"
+        "if not os.path.exists(m):\n"
+        "    open(m,'w').close(); time.sleep(120)\n"
+    )
+    # Margins sized for a loaded single-core box: the interpreter start of
+    # attempt 2 can take seconds, and only the *hang* (attempt 1 sleeping
+    # past stall_timeout after its beat) should count as a stall.
+    res = supervise(
+        _child(code),
+        stall_timeout_s=2.5,
+        max_restarts=3,
+        heartbeat_file=str(hb),
+        poll_s=0.2,
+        grace_s=30.0,
+        log=lambda _: None,
+    )
+    assert res.exit_code == 0
+    assert res.restarts == 1
+    assert res.stalls == 1
+    # The hung child must actually be gone (killed, not orphaned).
+    assert not _any_descendant_running(code)
+
+
+def _any_descendant_running(code_fragment: str) -> bool:
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                if code_fragment.encode() in f.read():
+                    return True
+        except OSError:
+            continue
+    return False
+
+
+def test_gives_up_after_max_restarts(tmp_path):
+    res = supervise(
+        _child("import sys; sys.exit(7)"),
+        stall_timeout_s=5,
+        max_restarts=2,
+        heartbeat_file=str(tmp_path / "hb"),
+        poll_s=0.05,
+        log=lambda _: None,
+    )
+    assert res.exit_code == 7
+    assert res.restarts == 2
+
+
+def test_child_argv_strips_supervision_flags():
+    argv = [
+        "train", "--config", "pod64", "--supervise",
+        "--stall-timeout", "30", "--max-restarts=9",
+        "--checkpoint-dir", "runs/x",
+    ]
+    child = child_argv_from_cli(argv, "/tmp/hb")
+    assert child[:3] == [sys.executable, "-m", "featurenet_tpu.cli"]
+    tail = child[3:]
+    assert "--supervise" not in tail
+    assert "--stall-timeout" not in tail
+    assert "30" not in tail
+    assert not any(a.startswith("--max-restarts") for a in tail)
+    assert tail[-2:] == ["--heartbeat-file", "/tmp/hb"]
+    assert "--checkpoint-dir" in tail and "runs/x" in tail

@@ -49,9 +49,13 @@ def test_smoke16_end_to_end(tmp_path):
         eval_batches=2,
         checkpoint_dir=str(tmp_path / "ckpt"),
         data_workers=2,
+        heartbeat_file=str(tmp_path / "heartbeat"),
     )
     trainer = Trainer(cfg)
     last = trainer.run()
+    # Liveness heartbeat (train.supervisor contract): the run must have
+    # touched the file at its confirmed-progress points.
+    assert (tmp_path / "heartbeat").exists()
     # Chance is 1/24 ≈ 4.2%; a working pipeline clears 3x chance even this short.
     assert last["eval_accuracy"] > 3 / 24, last
 
