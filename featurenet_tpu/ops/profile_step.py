@@ -11,53 +11,40 @@ individual blocks; (c)-(b) is the backward cost; (d)-(c) is optimizer +
 wire-unpack + augmentation overhead. Results drive backend defaults the same
 way `ops/bench_ops.py` does (BASELINE.md).
 
-Timing method matches bench_ops: each measured fn is dispatched as one
-compiled call; wall = time to a device->host readback of a scalar derived
-from the output (block_until_ready returns early through the tunnel); the
-(2N-N)/N slope subtracts the constant round-trip.
+Timing method matches bench.py: the measured fn is jitted to return ONE
+scalar; wall(k) = time for k sequential dispatches + a readback of the last
+scalar (block_until_ready returns early through the tunnel — a readback is
+the honest sync); per-call time = (wall(N+1) - wall(1)) / N, which cancels
+the constant dispatch/round-trip latency. One compile per measured shape —
+no scan chaining (compiling scans of full conv stacks proved pathologically
+slow on this toolchain).
 """
 
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import time
 
 import numpy as np
 
 
-def _wall(fn, args, repeats: int = 5) -> float:
-    """Best-of-N wall seconds for one dispatch of ``fn`` + scalar readback."""
-    import jax.numpy as jnp
+def _slope_time(fn, args, iters: int = 12, repeats: int = 3) -> float:
+    """Per-call seconds of a jitted scalar-returning fn via slope timing."""
+    float(fn(*args))  # compile + warm
 
-    out = fn(*args)  # compile + warm
-    leaf = out[0] if isinstance(out, tuple) else out
-    float(jnp.sum(leaf) if leaf.ndim else leaf)
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        leaf = out[0] if isinstance(out, tuple) else out
-        float(jnp.sum(leaf) if leaf.ndim else leaf)
-        best = min(best, time.perf_counter() - t0)
-    return best
+    def wall(k: int) -> float:
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(k):
+                out = fn(*args)
+            float(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
 
-
-def _scan_time(step_fn, init_carry, iters: int = 24) -> float:
-    """Per-iteration seconds of ``carry -> carry`` via scan slope timing."""
-    import jax
-
-    def chained(n):
-        def run(c):
-            out, _ = jax.lax.scan(lambda c, _: (step_fn(c), ()), c, None,
-                                  length=n)
-            return out
-        return jax.jit(run)
-
-    t1 = _wall(chained(iters), (init_carry,))
-    t2 = _wall(chained(2 * iters), (init_carry,))
-    return (t2 - t1) / iters
+    return (wall(1 + iters) - wall(1)) / iters
 
 
 def main() -> None:
@@ -69,7 +56,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from featurenet_tpu.config import get_config
-    from featurenet_tpu.data.synthetic import WIRE_KEYS, generate_batch, to_wire
+    from featurenet_tpu.data.synthetic import generate_batch, to_wire
     from featurenet_tpu.models import FeatureNet
     from featurenet_tpu.models.featurenet import FeatureNetArch
     from featurenet_tpu.train.state import create_state
@@ -127,11 +114,11 @@ def main() -> None:
         model_k = Tower(arch=a, blocks=k)
         vs = model_k.init({"params": jax.random.key(0)}, voxels, train=False)
 
-        def fwd_sum(c, _m=model_k, _vs=vs):
-            y = _m.apply(_vs, voxels, train=False)
-            return c + jnp.sum(y).astype(c.dtype) * 1e-12
+        @jax.jit
+        def fwd_sum(vs, x, _m=model_k):
+            return jnp.sum(_m.apply(vs, x, train=False)).astype(jnp.float32)
 
-        t = _scan_time(fwd_sum, jnp.zeros((), jnp.float32))
+        t = _slope_time(fwd_sum, (vs, voxels))
         record(f"fwd_prefix_{k}blocks", t, flops_prefix)
         record(f"fwd_block_{k}_delta", t - prev)
         prev = t
@@ -155,21 +142,21 @@ def main() -> None:
             logits, labels
         ).mean(), new_vars
 
-    t_fwd = _scan_time(
-        lambda c: c + loss_fn(params, batch_stats)[0] * 1e-12,
-        jnp.zeros((), jnp.float32),
+    t_fwd = _slope_time(
+        jax.jit(lambda p, bs: loss_fn(p, bs)[0]), (params, batch_stats)
     )
     record("full_fwd_train", t_fwd)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def fwdbwd(c):
-        (loss, _), grads = grad_fn(params, batch_stats)
-        return c + (loss + jax.tree_util.tree_reduce(
+    @jax.jit
+    def fwdbwd(p, bs):
+        (loss, _), grads = grad_fn(p, bs)
+        return loss + jax.tree_util.tree_reduce(
             lambda x, y: x + jnp.sum(y).astype(jnp.float32), grads, 0.0
-        )) * 1e-12
+        )
 
-    t_fb = _scan_time(fwdbwd, jnp.zeros((), jnp.float32))
+    t_fb = _slope_time(fwdbwd, (params, batch_stats))
     record("full_fwd_bwd", t_fb)
     record("bwd_delta", t_fb - t_fwd)
 
