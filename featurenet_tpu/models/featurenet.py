@@ -69,6 +69,15 @@ class FeatureNetArch:
     # Backend for the stride-1 conv blocks: "xla" (default — measured
     # fastest, BASELINE.md) or "pallas" (ops/conv3d.py, fp32).
     conv_backend: str = "xla"
+    # Head: flatten (paper-shape; correct for the shallow 64³ stack) or
+    # global-average-pool (deep stacks: a flattened 8³×256 head is 33M
+    # params of dropout-starved dense layer — the measured cause of the
+    # abc128 uniform-output collapse; GAP heads are also pose-robust).
+    head_gap: bool = False
+    # Residual skips around stride-1 blocks whose input/output channel
+    # counts match (pooling stays outside the skip). Identity branches keep
+    # deep stacks trainable; no-op for the paper-shape 4-block stack.
+    residual: bool = False
 
     def __post_init__(self):
         n = len(self.features)
@@ -90,7 +99,13 @@ def tiny_arch(num_classes: int = NUM_CLASSES) -> FeatureNetArch:
 
 
 def deep_arch(num_classes: int = NUM_CLASSES) -> FeatureNetArch:
-    """The abc128 stretch config: deeper net for 128³ inputs (BASELINE config 5)."""
+    """The abc128 stretch config: deeper net for 128³ inputs (BASELINE config 5).
+
+    GAP head + residual skips: the original flatten head put 33.6 M of the
+    35.3 M params in one dropout-starved dense layer and the net collapsed
+    into the uniform-output absorbing state at every tried lr (BASELINE.md
+    training-dynamics note); with GAP + skips the same conv tower trains.
+    """
     return FeatureNetArch(
         features=(32, 64, 64, 128, 128, 256),
         kernels=(7, 3, 3, 3, 3, 3),
@@ -99,16 +114,21 @@ def deep_arch(num_classes: int = NUM_CLASSES) -> FeatureNetArch:
         hidden=256,
         dropout=0.5,
         num_classes=num_classes,
+        head_gap=True,
+        residual=True,
     )
 
 
 class ConvBNRelu(nn.Module):
-    """conv → batchnorm → relu [→ maxpool], bf16 compute / fp32 BN."""
+    """conv → batchnorm → relu, bf16 compute / fp32 BN.
+
+    Pooling deliberately lives at the call site (FeatureNet pools after the
+    optional residual add; the segmenter strides instead) so the window
+    config exists in exactly one place per model."""
 
     features: int
     kernel: int
     stride: int = 1
-    pool: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     stem_s2d: bool = True
     conv_backend: str = "xla"
@@ -145,10 +165,7 @@ class ConvBNRelu(nn.Module):
             param_dtype=jnp.float32,
         )(x)
         x = nn.relu(x)
-        x = x.astype(self.dtype)
-        if self.pool:
-            x = nn.max_pool(x, window_shape=(2, 2, 2), strides=(2, 2, 2))
-        return x
+        return x.astype(self.dtype)
 
 
 class FeatureNet(nn.Module):
@@ -169,13 +186,26 @@ class FeatureNet(nn.Module):
         a = self.arch
         x = voxels.astype(self.dtype)
         for f, k, s, p in zip(a.features, a.kernels, a.strides, a.pool_after):
-            x = ConvBNRelu(
-                f, k, s, p,
+            y = ConvBNRelu(
+                f, k, s,
                 dtype=self.dtype,
                 stem_s2d=a.stem_s2d,
                 conv_backend=a.conv_backend,
             )(x, train)
-        x = x.reshape((x.shape[0], -1))
+            if a.residual and s == 1 and x.shape[-1] == f:
+                y = y + x  # identity skip; pooling stays outside the branch
+            x = (
+                nn.max_pool(y, window_shape=(2, 2, 2), strides=(2, 2, 2))
+                if p
+                else y
+            )
+        if a.head_gap:
+            # fp32 accumulation for the spatial mean, back to compute dtype.
+            x = jnp.mean(
+                x, axis=(1, 2, 3), dtype=jnp.float32
+            ).astype(self.dtype)
+        else:
+            x = x.reshape((x.shape[0], -1))
         x = nn.Dense(a.hidden, dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = nn.relu(x)
         x = nn.Dropout(rate=a.dropout, deterministic=not train)(x)

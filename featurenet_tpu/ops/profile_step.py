@@ -11,7 +11,8 @@ individual blocks; (c)-(b) is the backward cost; (d)-(c) is optimizer +
 wire-unpack + augmentation overhead. Results drive backend defaults the same
 way `ops/bench_ops.py` does (BASELINE.md).
 
-Timing method matches bench.py: the measured fn is jitted to return ONE
+Timing method matches the repo-root ``bench.py`` (NOT ops/bench_ops.py,
+which scan-chains): the measured fn is jitted to return ONE
 scalar; wall(k) = time for k sequential dispatches + a readback of the last
 scalar (block_until_ready returns early through the tunnel — a readback is
 the honest sync); per-call time = (wall(N+1) - wall(1)) / N, which cancels
@@ -95,8 +96,12 @@ def main() -> None:
             for f, k_, s, p in list(
                 zip(t.features, t.kernels, t.strides, t.pool_after)
             )[: self.blocks]:
-                x = ConvBNRelu(f, k_, s, p, stem_s2d=t.stem_s2d,
+                x = ConvBNRelu(f, k_, s, stem_s2d=t.stem_s2d,
                                conv_backend=t.conv_backend)(x, train)
+                if p:  # pool at the call site, same as FeatureNet
+                    x = nn.max_pool(
+                        x, window_shape=(2, 2, 2), strides=(2, 2, 2)
+                    )
             return x
 
     prev = 0.0

@@ -81,3 +81,53 @@ def test_segmenter_output_shape():
 def test_custom_arch_validation():
     with pytest.raises(ValueError):
         FeatureNetArch(features=(32,), kernels=(3, 3))
+
+
+def test_gap_residual_arch_trains_where_flatten_head_matches_shape():
+    """deep_arch-style head/skips (abc128): GAP head output is
+    resolution-independent, residual skips add no params, and gradients
+    reach the stem (the flatten-head collapse starved it — BASELINE.md)."""
+    arch = FeatureNetArch(
+        features=(8, 8, 8),
+        kernels=(3, 3, 3),
+        strides=(2, 1, 1),
+        pool_after=(False, True, True),
+        hidden=16,
+        dropout=0.0,
+        head_gap=True,
+        residual=True,
+    )
+    model = FeatureNet(arch=arch)
+    x16 = jnp.asarray(np.random.default_rng(0).random((2, 16, 16, 16, 1)),
+                      jnp.float32)
+    x32 = jnp.asarray(np.random.default_rng(1).random((2, 32, 32, 32, 1)),
+                      jnp.float32)
+    v16 = model.init({"params": jax.random.key(0)}, x16, train=False)
+    # GAP head: the same param tree must serve any resolution (a flatten
+    # head would need a different Dense shape at 32³).
+    assert model.apply(v16, x32, train=False).shape == (2, 24)
+
+    # Residual skips are identity branches: param tree identical to the
+    # same arch without skips.
+    import dataclasses
+
+    v_noskip = FeatureNet(
+        arch=dataclasses.replace(arch, residual=False)
+    ).init({"params": jax.random.key(0)}, x16, train=False)
+    assert jax.tree_util.tree_structure(
+        v16["params"]
+    ) == jax.tree_util.tree_structure(v_noskip["params"])
+
+    # Gradients reach the stem conv (nonzero), i.e. the skip path did not
+    # detach the tower from the loss.
+    def loss(params):
+        out = model.apply(
+            {"params": params, "batch_stats": v16["batch_stats"]},
+            x16, train=True, rngs={"dropout": jax.random.key(2)},
+            mutable=["batch_stats"],
+        )[0]
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(v16["params"])
+    stem_grads = jax.tree_util.tree_leaves(grads["ConvBNRelu_0"])
+    assert any(float(jnp.max(jnp.abs(g))) > 0.0 for g in stem_grads)
