@@ -126,14 +126,19 @@ def main(argv=None) -> None:
     p_bld.add_argument("--stl-root", required=True)
     p_bld.add_argument("--out", required=True)
     p_bld.add_argument("--resolution", type=int, default=64)
-    p_inf = sub.add_parser("infer",
-                           help="classify STL files with a trained checkpoint")
+    p_inf = sub.add_parser("infer", allow_abbrev=False,
+                           help="classify or segment STL files with a "
+                                "trained checkpoint")
     p_inf.add_argument("stl", nargs="+", help="STL file path(s)")
     p_inf.add_argument("--checkpoint-dir", required=True)
     p_inf.add_argument("--config", default="pod64")
     p_inf.add_argument("--resolution", type=int,
                        help="must match the trained checkpoint's resolution "
                             "when the run overrode the preset")
+    p_inf.add_argument("--seg-out",
+                       help="segment checkpoints: also write each part's "
+                            "per-voxel label grid to this directory as "
+                            "<stem>_seg.npz")
     args = parser.parse_args(argv)
 
     if args.cmd == "train" and getattr(args, "supervise", False):
@@ -213,17 +218,45 @@ def main(argv=None) -> None:
         print(json.dumps({"built": index["counts"]}))
         return
     if args.cmd == "infer":
+        import os
+
         from featurenet_tpu.config import get_config
-        from featurenet_tpu.infer import Predictor
+        from featurenet_tpu.infer import Predictor, SegPrediction
 
         over = (
             {"resolution": args.resolution} if args.resolution else {}
         )
-        pred = Predictor.from_checkpoint(
-            args.checkpoint_dir, get_config(args.config, **over)
-        )
+        cfg = get_config(args.config, **over)
+        if args.seg_out and cfg.task != "segment":
+            raise SystemExit(
+                "--seg-out only applies to segmentation checkpoints "
+                f"(config {cfg.name!r} has task={cfg.task!r}); it would "
+                "silently produce no label grids"
+            )
+        pred = Predictor.from_checkpoint(args.checkpoint_dir, cfg)
+        if args.seg_out:
+            os.makedirs(args.seg_out, exist_ok=True)
+        used_names: set = set()
         for r in pred.predict_stl(args.stl):
-            print(json.dumps(dataclasses.asdict(r)))
+            if isinstance(r, SegPrediction):
+                row = {"path": r.path, "voxel_counts": r.voxel_counts}
+                if args.seg_out:
+                    import numpy as np
+
+                    stem = os.path.splitext(os.path.basename(r.path))[0]
+                    # Same-stem inputs from different dirs must not
+                    # overwrite each other's grids.
+                    name, i = f"{stem}_seg.npz", 1
+                    while name in used_names:
+                        name = f"{stem}_{i}_seg.npz"
+                        i += 1
+                    used_names.add(name)
+                    out_path = os.path.join(args.seg_out, name)
+                    np.savez_compressed(out_path, labels=r.labels)
+                    row["labels_path"] = out_path
+                print(json.dumps(row))
+            else:
+                print(json.dumps(dataclasses.asdict(r)))
         return
 
     if getattr(args, "debug_nans", False):

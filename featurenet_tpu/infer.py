@@ -10,6 +10,12 @@ Usage:
     p = Predictor.from_checkpoint("ckpts/", config=get_config("pod64"))
     labels, probs = p.predict_voxels(grids)          # [N,R,R,R] occupancy
     results = p.predict_stl(["part.stl", ...])       # end-to-end
+
+Segmentation checkpoints (``task='segment'``) use the same entry points;
+``predict_stl`` then returns ``SegPrediction`` (per-voxel label grid +
+per-class feature-voxel counts), and the grid path is
+``predict_voxels_seg``. The per-voxel argmax runs on device so only int8
+labels — not the 25-channel probability volume — cross back to the host.
 """
 
 from __future__ import annotations
@@ -34,6 +40,16 @@ class Prediction:
     top3: list[tuple[str, float]]
 
 
+@dataclasses.dataclass
+class SegPrediction:
+    path: str
+    # Predicted feature-voxel counts, class_name -> count (background 0
+    # excluded); empty dict = no feature voxels predicted.
+    voxel_counts: dict[str, int]
+    # Per-voxel labels [R, R, R] int8: 0 = stock/air, 1+c = feature class c.
+    labels: np.ndarray = dataclasses.field(repr=False)
+
+
 class Predictor:
     """Fixed-shape compiled classifier forward over a trained checkpoint.
 
@@ -53,13 +69,29 @@ class Predictor:
         self._params = params
         self._stats = batch_stats
 
-        def forward(params, batch_stats, voxels):
-            logits = self.model.apply(
-                {"params": params, "batch_stats": batch_stats},
-                voxels,
-                train=False,
-            )
-            return jax.nn.softmax(logits, axis=-1)
+        if cfg.task == "segment":
+
+            def forward(params, batch_stats, voxels):
+                logits = self.model.apply(
+                    {"params": params, "batch_stats": batch_stats},
+                    voxels,
+                    train=False,
+                )
+                # Argmax on device: int8 labels cross the link, not the
+                # (num_classes+1)-channel fp32 probability volume.
+                return jax.numpy.argmax(logits, axis=-1).astype(
+                    jax.numpy.int8
+                )
+
+        else:
+
+            def forward(params, batch_stats, voxels):
+                logits = self.model.apply(
+                    {"params": params, "batch_stats": batch_stats},
+                    voxels,
+                    train=False,
+                )
+                return jax.nn.softmax(logits, axis=-1)
 
         self._forward = jax.jit(forward)
 
@@ -105,6 +137,36 @@ class Predictor:
         Returns ``(labels int32 [N], probs float32 [N, num_classes])``.
         Inputs are chunked/padded to the static compile batch.
         """
+        if self.cfg.task == "segment":
+            raise ValueError(
+                "this Predictor wraps a segmentation checkpoint — use "
+                "predict_voxels_seg (per-voxel labels), not class probs"
+            )
+        g = self._validated(grids)
+        n = g.shape[0]
+        if n == 0:
+            return (
+                np.zeros((0,), np.int32),
+                np.zeros((0, len(CLASS_NAMES)), np.float32),
+            )
+        probs = self._batched_forward(g)
+        return probs.argmax(axis=-1).astype(np.int32), probs
+
+    def _batched_forward(self, g: np.ndarray) -> np.ndarray:
+        """Chunk/pad ``g`` to the static compile batch, run, trim, concat."""
+        out = []
+        for s in range(0, g.shape[0], self.batch):
+            chunk = g[s : s + self.batch]
+            pad = self.batch - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad,) + chunk.shape[1:], np.float32)]
+                )
+            y = np.asarray(self._forward(self._params, self._stats, chunk))
+            out.append(y[: self.batch - pad])
+        return np.concatenate(out, axis=0)
+
+    def _validated(self, grids: np.ndarray) -> np.ndarray:
         g = np.asarray(grids, dtype=np.float32)
         if g.ndim == 4:
             g = g[..., None]
@@ -113,35 +175,58 @@ class Predictor:
             raise ValueError(
                 f"expected [N,{R},{R},{R}(,1)] grids, got {g.shape}"
             )
-        n = g.shape[0]
-        if n == 0:
-            return (
-                np.zeros((0,), np.int32),
-                np.zeros((0, len(CLASS_NAMES)), np.float32),
+        return g
+
+    def predict_voxels_seg(self, grids: np.ndarray) -> np.ndarray:
+        """Per-voxel labels for ``[N, R, R, R]`` grids (segment checkpoints).
+
+        Returns int8 ``[N, R, R, R]``: 0 = stock/air, 1+c = feature class c.
+        """
+        if self.cfg.task != "segment":
+            raise ValueError(
+                "this Predictor wraps a classification checkpoint — use "
+                "predict_voxels"
             )
-        probs = []
-        for s in range(0, n, self.batch):
-            chunk = g[s : s + self.batch]
-            pad = self.batch - chunk.shape[0]
-            if pad:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((pad,) + chunk.shape[1:], np.float32)]
-                )
-            p = np.asarray(self._forward(self._params, self._stats, chunk))
-            probs.append(p[: self.batch - pad])
-        probs = np.concatenate(probs, axis=0)
-        return probs.argmax(axis=-1).astype(np.int32), probs
+        g = self._validated(grids)
+        R = self.cfg.resolution
+        if g.shape[0] == 0:
+            return np.zeros((0, R, R, R), np.int8)
+        return self._batched_forward(g)
 
     def predict_stl(
         self, paths: Sequence[str], fill: bool = True
-    ) -> list[Prediction]:
-        """End-to-end: STL file → normalized voxel grid → class prediction."""
+    ) -> list[Prediction] | list[SegPrediction]:
+        """End-to-end: STL file → normalized voxel grid → prediction.
+
+        Classification checkpoints return ``Prediction`` (class + top-3);
+        segmentation checkpoints return ``SegPrediction`` (per-voxel label
+        grid + feature-voxel counts by class).
+        """
         if not paths:
             return []
         R = self.cfg.resolution
         grids = np.stack(
             [voxelize(load_stl(p), R, fill=fill) for p in paths]
         )
+        if self.cfg.task == "segment":
+            label_grids = self.predict_voxels_seg(grids)
+            seg_out: list[SegPrediction] = []
+            for path, lab in zip(paths, label_grids):
+                counts = np.bincount(
+                    lab.ravel(), minlength=len(CLASS_NAMES) + 1
+                )
+                seg_out.append(
+                    SegPrediction(
+                        path=path,
+                        voxel_counts={
+                            CLASS_NAMES[c - 1]: int(counts[c])
+                            for c in range(1, len(counts))
+                            if counts[c]
+                        },
+                        labels=lab,
+                    )
+                )
+            return seg_out
         labels, probs = self.predict_voxels(grids)
         out = []
         for path, lab, pr in zip(paths, labels, probs):
