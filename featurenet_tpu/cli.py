@@ -233,7 +233,12 @@ def main(argv=None) -> None:
                 f"(config {cfg.name!r} has task={cfg.task!r}); it would "
                 "silently produce no label grids"
             )
-        pred = Predictor.from_checkpoint(args.checkpoint_dir, cfg)
+        # Compile batch sized to the request: padding 1 STL to the default
+        # 32 would run 32x the needed FLOPs (felt hardest by the
+        # full-resolution segmentation decoder).
+        pred = Predictor.from_checkpoint(
+            args.checkpoint_dir, cfg, batch=min(32, len(args.stl))
+        )
         if args.seg_out:
             os.makedirs(args.seg_out, exist_ok=True)
         used_names: set = set()

@@ -69,29 +69,19 @@ class Predictor:
         self._params = params
         self._stats = batch_stats
 
-        if cfg.task == "segment":
-
-            def forward(params, batch_stats, voxels):
-                logits = self.model.apply(
-                    {"params": params, "batch_stats": batch_stats},
-                    voxels,
-                    train=False,
-                )
+        def forward(params, batch_stats, voxels):
+            logits = self.model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                voxels,
+                train=False,
+            )
+            if cfg.task == "segment":
                 # Argmax on device: int8 labels cross the link, not the
                 # (num_classes+1)-channel fp32 probability volume.
                 return jax.numpy.argmax(logits, axis=-1).astype(
                     jax.numpy.int8
                 )
-
-        else:
-
-            def forward(params, batch_stats, voxels):
-                logits = self.model.apply(
-                    {"params": params, "batch_stats": batch_stats},
-                    voxels,
-                    train=False,
-                )
-                return jax.nn.softmax(logits, axis=-1)
+            return jax.nn.softmax(logits, axis=-1)
 
         self._forward = jax.jit(forward)
 
