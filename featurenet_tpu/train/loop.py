@@ -129,7 +129,12 @@ class Trainer:
             ),
             out_shardings=rep,
         )
-        self._step_rng = jax.device_put(jax.random.key(cfg.seed + 1), rep)
+        # Computed under jit with an output sharding (not device_put): a
+        # multi-process mesh's replicated sharding spans non-addressable
+        # devices, which device_put refuses but GSPMD computation handles.
+        self._step_rng = jax.jit(
+            lambda: jax.random.key(cfg.seed + 1), out_shardings=rep
+        )()
 
         # --- data -----------------------------------------------------------
         # Each host generates only its 1/process_count slice of the global
