@@ -121,6 +121,14 @@ def main(argv=None) -> None:
     p_seg.add_argument("--resolution", type=int, default=64)
     p_seg.add_argument("--num-features", type=int, default=3)
     p_seg.add_argument("--seed", type=int, default=0)
+    p_stl = sub.add_parser("export-stl-data", allow_abbrev=False,
+                           help="materialize the synthetic benchmark as an "
+                                "STL class tree (the reference dataset's "
+                                "on-disk shape; ingest with build-cache)")
+    p_stl.add_argument("--out", required=True)
+    p_stl.add_argument("--per-class", type=int, default=10)
+    p_stl.add_argument("--resolution", type=int, default=64)
+    p_stl.add_argument("--seed", type=int, default=0)
     p_bld = sub.add_parser("build-cache",
                            help="voxelize an STL class tree into an npz cache")
     p_bld.add_argument("--stl-root", required=True)
@@ -210,6 +218,15 @@ def main(argv=None) -> None:
             "exported": sum(s["count"] for s in index["shards"]),
             "shards": len(index["shards"]),
         }))
+        return
+    if args.cmd == "export-stl-data":
+        from featurenet_tpu.data.voxel_to_mesh import export_stl_tree
+
+        index = export_stl_tree(
+            args.out, per_class=args.per_class,
+            resolution=args.resolution, seed=args.seed,
+        )
+        print(json.dumps({"exported": index["counts"]}))
         return
     if args.cmd == "build-cache":
         from featurenet_tpu.data.offline import build_cache

@@ -1,0 +1,120 @@
+"""Voxel occupancy grid → watertight triangle mesh (boundary-face surface).
+
+Closes the reverse arc of the data loop. The reference ships a 24k-model STL
+benchmark and a one-way STL→voxel preprocessor (SURVEY.md §2 C2); that
+dataset is not present in this environment, so training runs on the
+parametric voxel generator. This module lets the generator *materialize an
+actual STL benchmark on disk*: every boundary face between an occupied and
+an empty voxel becomes two triangles, producing a closed, consistently
+outward-wound surface that the STL→voxel front end (``data.voxelize``,
+``cli build-cache``) can ingest like any external dataset.
+
+Geometry contract: vertices lie on voxel-cell corners at coordinates
+``index / R`` in the unit cube. Faces therefore sit on planes ``j / R``
+while the voxelizer's parity fill casts rays through voxel *centers*
+``(i + 0.5) / R`` — never on a face plane — so
+``voxelize(voxels_to_mesh(g), R, fill=True, normalize=False)`` reproduces
+``g`` exactly (tested), and ``build-cache`` (which re-normalizes like it
+must for arbitrary external STL) reproduces it up to the normalization
+margin.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# One entry per face direction: (axis, positive_side, quad corner offsets).
+# Corner offsets are in the face plane's own 2D basis (u, v) and are wound
+# counter-clockwise when viewed from outside (normal = outward).
+_DIRECTIONS = (
+    (0, True), (0, False),
+    (1, True), (1, False),
+    (2, True), (2, False),
+)
+
+
+def _face_quads(cells: np.ndarray, axis: int, positive: bool) -> np.ndarray:
+    """Quad corners ``[n, 4, 3]`` (float32, voxel-index coords) for boundary
+    faces of ``cells [n, 3]`` in direction ``axis``/``positive``."""
+    base = cells.astype(np.float32)
+    if positive:
+        base[:, axis] += 1.0
+    u_axis, v_axis = [a for a in (0, 1, 2) if a != axis]
+    quads = np.repeat(base[:, None, :], 4, axis=1)  # [n, 4, 3]
+    # CCW from outside: for a +axis face the (u, v) winding keeps the
+    # right-hand normal along +axis; a -axis face reverses it.
+    order = (
+        ((0, 0), (1, 0), (1, 1), (0, 1))
+        if (axis in (0, 2)) == positive
+        else ((0, 0), (0, 1), (1, 1), (1, 0))
+    )
+    for corner, (du, dv) in enumerate(order):
+        quads[:, corner, u_axis] += du
+        quads[:, corner, v_axis] += dv
+    return quads
+
+
+def voxels_to_mesh(grid: np.ndarray, scale: float | None = None) -> np.ndarray:
+    """Extract the boundary surface of a ``bool [R, R, R]`` grid.
+
+    Returns ``float32 [n, 3, 3]`` triangles (two per boundary face),
+    consistently wound with outward normals. ``scale`` multiplies vertex
+    coordinates; default ``1 / R`` places the grid in the unit cube (the
+    layout ``save_stl`` + ``voxelize(normalize=False)`` round-trip exactly).
+    An empty grid returns zero triangles.
+    """
+    g = np.asarray(grid).astype(bool)
+    if g.ndim != 3:
+        raise ValueError(f"expected [R, R, R] grid, got {g.shape}")
+    if scale is None:
+        scale = 1.0 / max(g.shape)
+    padded = np.pad(g, 1, constant_values=False)
+    quad_list = []
+    for axis, positive in _DIRECTIONS:
+        shift = np.roll(padded, -1 if positive else 1, axis=axis)
+        exposed = (padded & ~shift)[1:-1, 1:-1, 1:-1]
+        cells = np.argwhere(exposed)
+        quad_list.append(_face_quads(cells, axis, positive))
+    quads = np.concatenate(quad_list, axis=0)
+    # Quad [A, B, C, D] → triangles [A, B, C] and [A, C, D]; both inherit
+    # the quad's winding, so outward orientation is preserved.
+    tris = np.concatenate([quads[:, (0, 1, 2)], quads[:, (0, 2, 3)]], axis=0)
+    return (tris * np.float32(scale)).astype(np.float32)
+
+
+def export_stl_tree(
+    out_root: str,
+    per_class: int = 10,
+    resolution: int = 64,
+    seed: int = 0,
+) -> dict:
+    """Materialize the synthetic benchmark as an STL class tree on disk.
+
+    Layout matches what ``cli build-cache`` ingests (the reference dataset's
+    shape): ``out_root/<class_name>/<class_name>_<i>.stl``. Returns
+    ``{"counts": {class_name: n}}``.
+    """
+    import os
+
+    from featurenet_tpu.data.stl import save_stl
+    from featurenet_tpu.data.synthetic import CLASS_NAMES, generate_sample
+
+    counts = {}
+    for cls_id, cls in enumerate(CLASS_NAMES):
+        # Per-class seed stream (same scheme as offline.export_synthetic_
+        # cache): sample i of class c is identical regardless of per_class
+        # or which other classes are exported.
+        rng = np.random.default_rng(np.random.SeedSequence([seed, cls_id]))
+        cdir = os.path.join(out_root, cls)
+        os.makedirs(cdir, exist_ok=True)
+        for i in range(per_class):
+            voxels, _labels, _seg = generate_sample(
+                rng, resolution, label=cls_id
+            )
+            save_stl(
+                os.path.join(cdir, f"{cls}_{i:04d}.stl"),
+                voxels_to_mesh(voxels),
+                name=f"{cls}_{i}",
+            )
+        counts[cls] = per_class
+    return {"counts": counts}

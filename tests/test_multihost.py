@@ -106,11 +106,14 @@ def _run_workers(port: int, steps: int, nproc: int) -> list[str]:
         for i, p in enumerate(procs)
     ]
     try:
+        import time
+
         for t in threads:
             t.start()
         deadline = 600
+        end = time.monotonic() + deadline  # shared bound, not per-thread
         for t in threads:
-            t.join(timeout=deadline)
+            t.join(timeout=max(0.0, end - time.monotonic()))
         if any(t.is_alive() for t in threads):
             raise AssertionError(
                 f"workers did not finish within {deadline}s: "
