@@ -46,15 +46,60 @@ def build_cache(
     classes: Sequence[str] | None = None,
     backend: str = "auto",
 ) -> dict:
-    """Voxelize an STL class tree into npz shards. Returns the index dict."""
+    """Voxelize an STL class tree into npz shards. Returns the index dict.
+
+    Labeling: the index's ``label_ids`` pins every class directory whose
+    name matches a canonical CLASS_NAMES entry to that entry's id — even in
+    a partial tree — so cache-trained checkpoints agree with the
+    Predictor's id→name mapping (a positional/alphabetical scheme silently
+    permuted labels: eval looked fine, infer answered nonsense). Unknown
+    directory names get ids after the canonical block; training on those
+    needs a config whose ``num_classes`` covers them.
+    """
     if resolution % 8:
         raise ValueError("resolution must be divisible by 8 (packed wire)")
     os.makedirs(out_root, exist_ok=True)
-    classes = list(classes) if classes is not None else sorted(
-        d for d in os.listdir(stl_root)
-        if os.path.isdir(os.path.join(stl_root, d))
-    )
-    index = {"resolution": resolution, "classes": [], "counts": {}}
+    if classes is None:
+        found = {
+            d for d in os.listdir(stl_root)
+            if os.path.isdir(os.path.join(stl_root, d))
+        }
+        classes = [c for c in CLASS_NAMES if c in found] + sorted(
+            found - set(CLASS_NAMES)
+        )
+    else:
+        classes = list(classes)
+    known = {c: i for i, c in enumerate(CLASS_NAMES)}
+    next_id = len(CLASS_NAMES)
+    label_ids = {}
+    for cls in classes:
+        if cls in known:
+            label_ids[cls] = known[cls]
+        else:
+            label_ids[cls] = next_id
+            next_id += 1
+    if next_id > len(CLASS_NAMES):
+        import sys
+
+        unknown = [c for c in classes if c not in known]
+        print(
+            json.dumps({
+                "build_cache_warning":
+                    "non-canonical class dirs (typo'd benchmark name, or "
+                    "a custom class) get label ids past the canonical "
+                    f"block; training them needs num_classes >= {next_id} "
+                    "(stock presets have 24 — the Trainer refuses "
+                    "out-of-range labels)",
+                "dirs": unknown,
+            }),
+            file=sys.stderr,
+        )
+    index = {
+        "resolution": resolution,
+        "classes": [],
+        "counts": {},
+        "label_ids": label_ids,
+    }
     for cls in classes:
         cdir = os.path.join(stl_root, cls)
         files = sorted(f for f in os.listdir(cdir) if f.lower().endswith(".stl"))
@@ -358,17 +403,29 @@ class VoxelCacheDataset:
         self.augment = augment
 
         # Index into the shared memo arrays instead of copying rows out:
-        # sample m is self._grids[self.labels[m]][self.rows[m]]. Only the
+        # sample m is self._grids[self._cls_pos[m]][self.rows[m]]. Only the
         # per-batch gather below materializes sample copies.
+        #
+        # Storage position != semantic label: ``label_ids`` in the index
+        # (written by build_cache) pins each class name to its canonical
+        # CLASS_NAMES id so a partial tree still trains the same label the
+        # Predictor will report. Caches without the field (old exports,
+        # export_synthetic_cache's always-complete canonical tree) fall
+        # back to position.
         self._grids = [grids[cls] for cls in self.index["classes"]]
-        rows, labels = [], []
-        for cls_id, cls in enumerate(self.index["classes"]):
-            n = self._grids[cls_id].shape[0]
+        label_ids = self.index.get("label_ids") or {
+            cls: pos for pos, cls in enumerate(self.index["classes"])
+        }
+        rows, labels, cls_pos = [], [], []
+        for pos, cls in enumerate(self.index["classes"]):
+            n = self._grids[pos].shape[0]
             r = _hash_split_rows(n, split, test_fraction)
             rows.append(r)
-            labels.append(np.full(len(r), cls_id, dtype=np.int32))
+            cls_pos.append(np.full(len(r), pos, dtype=np.int32))
+            labels.append(np.full(len(r), int(label_ids[cls]), dtype=np.int32))
         self.rows = np.concatenate(rows)
         self.labels = np.concatenate(labels)
+        self._cls_pos = np.concatenate(cls_pos)
         if len(self.labels) == 0:
             raise ValueError(f"empty split {split!r} in {cache_root}")
 
@@ -382,7 +439,7 @@ class VoxelCacheDataset:
         traffic and host→device transfer than float32 batches."""
         samples = []
         for m in idx:
-            g = self._grids[self.labels[m]][self.rows[m]]
+            g = self._grids[self._cls_pos[m]][self.rows[m]]
             if rng is not None:
                 g = random_orientation(rng)(g)
             samples.append(pack_voxels(g))  # validates W % 8

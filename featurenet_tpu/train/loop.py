@@ -180,6 +180,19 @@ class Trainer:
                 host_id=host_id,
                 seed=cfg.seed + 10_000,
             )
+            # A label the head can't express would train/evaluate silently
+            # wrong (one_hot of an out-of-range id is all-zero; integer CE
+            # clamps) — refuse up front.
+            max_label = int(
+                max(self.train_data.labels.max(), self.eval_data.labels.max())
+            )
+            if max_label >= cfg.arch.num_classes:
+                raise ValueError(
+                    f"cache {cfg.data_cache!r} contains label id {max_label} "
+                    f"but the model head has num_classes="
+                    f"{cfg.arch.num_classes}; non-canonical class dirs need "
+                    "a config with a larger head (see build_cache docs)"
+                )
         else:
             self.train_data = SyntheticVoxelDataset(
                 resolution=cfg.resolution,

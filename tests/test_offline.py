@@ -201,3 +201,56 @@ def test_trainer_segment_from_cache(tmp_path):
     assert np.isfinite(last["loss"])
     ev = tr.evaluate()
     assert "mean_iou" in ev and 0.0 <= ev["mean_iou"] <= 1.0
+
+
+def test_build_cache_orders_known_classes_canonically(tmp_path):
+    """Class ids are positional; known names must take CLASS_NAMES order
+    (alphabetical ordering permuted labels: cache-trained checkpoints then
+    mapped logits to the wrong names in infer — the bug this pins down)."""
+    from featurenet_tpu.data.mesh_primitives import mesh_box
+    from featurenet_tpu.data.stl import save_stl
+    from featurenet_tpu.data.synthetic import CLASS_NAMES
+
+    # Alphabetically, blind_hole < o_ring; canonically o_ring comes first.
+    chosen = ["o_ring", "blind_hole", "chamfer"]
+    assert sorted(chosen) != [
+        c for c in CLASS_NAMES if c in chosen
+    ], "pick classes whose two orders differ or the test is vacuous"
+    for cls in chosen + ["zz_custom"]:
+        d = tmp_path / "stl" / cls
+        d.mkdir(parents=True)
+        save_stl(str(d / "p.stl"), mesh_box((0.2,) * 3, (0.8,) * 3))
+    index = build_cache(str(tmp_path / "stl"), str(tmp_path / "cache"),
+                        resolution=16)
+    assert index["classes"] == [
+        c for c in CLASS_NAMES if c in chosen
+    ] + ["zz_custom"]
+    # Even in this PARTIAL tree, every known class trains under its
+    # canonical id (what the Predictor will report), not its position;
+    # the unknown class gets the first id past the canonical block.
+    assert index["label_ids"] == {
+        **{c: CLASS_NAMES.index(c) for c in chosen},
+        "zz_custom": len(CLASS_NAMES),
+    }
+    ds = VoxelCacheDataset(
+        str(tmp_path / "cache"), global_batch=8, split="train",
+        test_fraction=0.0,
+    )
+    want = {CLASS_NAMES.index(c) for c in chosen} | {len(CLASS_NAMES)}
+    assert set(ds.labels.tolist()) == want
+
+
+def test_trainer_refuses_out_of_range_cache_labels(stl_tree, tmp_path):
+    """boxy/roundy are non-canonical names → ids 24/25; a 24-way head must
+    refuse them up front instead of training them silently wrong."""
+    import pytest
+
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.train import Trainer
+
+    out = str(tmp_path / "cache")
+    build_cache(stl_tree, out, resolution=16)
+    cfg = get_config("smoke16", global_batch=8, data_cache=out,
+                     total_steps=1, data_workers=1)
+    with pytest.raises(ValueError, match="label id 2[45]"):
+        Trainer(cfg)
