@@ -237,6 +237,8 @@ def main(argv=None) -> None:
     if args.cmd == "infer":
         import os
 
+        import numpy as np
+
         from featurenet_tpu.config import get_config
         from featurenet_tpu.infer import Predictor, SegPrediction
 
@@ -263,8 +265,6 @@ def main(argv=None) -> None:
             if isinstance(r, SegPrediction):
                 row = {"path": r.path, "voxel_counts": r.voxel_counts}
                 if args.seg_out:
-                    import numpy as np
-
                     stem = os.path.splitext(os.path.basename(r.path))[0]
                     # Same-stem inputs from different dirs must not
                     # overwrite each other's grids.

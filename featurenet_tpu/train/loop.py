@@ -224,12 +224,10 @@ class Trainer:
         readback / eval / checkpoint) — never on mere dispatch, which
         succeeds even when the backend is hung.
         """
-        hb = self.cfg.heartbeat_file
-        if hb:
-            import os
+        if self.cfg.heartbeat_file:
+            from featurenet_tpu.train.supervisor import touch_heartbeat
 
-            with open(hb, "a"):
-                os.utime(hb, None)
+            touch_heartbeat(self.cfg.heartbeat_file)
 
     # ------------------------------------------------------------------
     def resume_if_available(self) -> int:

@@ -34,6 +34,14 @@ import time
 from typing import Optional, Sequence
 
 
+def touch_heartbeat(path: str) -> None:
+    """Create-or-touch the liveness file (both halves of the heartbeat
+    protocol use this: the Trainer to beat, the supervisor to reset the
+    baseline before each spawn)."""
+    with open(path, "a"):
+        os.utime(path, None)
+
+
 @dataclasses.dataclass
 class SuperviseResult:
     exit_code: int  # final child exit code (0 = success)
@@ -89,8 +97,7 @@ def supervise(
         # trigger (or mask) a stall verdict for this one. Its mtime is the
         # baseline: only a *newer* mtime proves the child itself beat, so
         # the cold-start grace (compile >> step time) governs until then.
-        with open(heartbeat_file, "a"):
-            os.utime(heartbeat_file, None)
+        touch_heartbeat(heartbeat_file)
         base_mtime = os.path.getmtime(heartbeat_file)
         started = time.monotonic()
         first_beat_seen = False
