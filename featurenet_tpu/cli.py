@@ -22,7 +22,11 @@ import json
 
 
 def _add_override_flags(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--config", default="pod64")
+    # default=None so "user explicitly asked for this preset" is
+    # distinguishable from "use the default": with a persisted checkpoint
+    # config, an explicit contradicting --config is a hard error while the
+    # bare default silently defers to the checkpoint.
+    p.add_argument("--config", default=None)
     p.add_argument("--resolution", type=int)
     p.add_argument("--global-batch", type=int)
     p.add_argument("--peak-lr", type=float)
@@ -94,6 +98,46 @@ def _apply_arch_overrides(cfg, args):
     return cfg
 
 
+def _cfg_from_checkpoint(saved, args):
+    """Persisted checkpoint config + run-policy overrides from ``args``.
+
+    Identity-defining flags (--config/--resolution/arch flags) must agree
+    with what the checkpoint was trained with — a silent mismatch restores
+    structurally-valid weights into the wrong model (the round-1 disease the
+    sidecar exists to kill), so contradiction is a hard error, not a merge.
+    """
+    from featurenet_tpu.config import check_identity
+
+    if getattr(args, "config", None) and args.config != saved.name:
+        raise SystemExit(
+            f"flags contradict the config persisted with this checkpoint: "
+            f"--config {args.config} (checkpoint: {saved.name}) — drop the "
+            "flag (the checkpoint self-configures), or point at a run "
+            "trained with these settings"
+        )
+    # Build the identity the flags request and let the one canonical check
+    # (config.check_identity, driven by IDENTITY_FIELDS) rule on it — a
+    # second hand-rolled field list here would drift as fields are added.
+    requested = saved
+    if getattr(args, "resolution", None):
+        requested = dataclasses.replace(
+            requested, resolution=args.resolution
+        )
+    requested = _apply_arch_overrides(requested, args)
+    try:
+        check_identity(saved, requested)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    over = _overrides(args)
+    over.pop("resolution", None)  # identity — already verified equal
+    # Ephemeral run-environment fields must not leak across runs: a stale
+    # heartbeat path or the training run's TB dir is never what an eval or
+    # resume meant unless the flag was passed again.
+    for k in ("heartbeat_file", "profile_dir", "tb_dir"):
+        over.setdefault(k, None)
+    return dataclasses.replace(saved, **over).validate()
+
+
 def main(argv=None) -> None:
     # allow_abbrev=False everywhere: the supervisor re-execs a rewritten argv
     # with supervision flags stripped by exact match — a prefix abbreviation
@@ -139,10 +183,19 @@ def main(argv=None) -> None:
                                 "trained checkpoint")
     p_inf.add_argument("stl", nargs="+", help="STL file path(s)")
     p_inf.add_argument("--checkpoint-dir", required=True)
-    p_inf.add_argument("--config", default="pod64")
+    p_inf.add_argument("--config", default=None,
+                       help="only needed for legacy checkpoints without a "
+                            "persisted config.json (default: read the "
+                            "checkpoint's own config)")
     p_inf.add_argument("--resolution", type=int,
-                       help="must match the trained checkpoint's resolution "
-                            "when the run overrode the preset")
+                       help="legacy checkpoints only: must match the "
+                            "trained resolution")
+    p_inf.add_argument("--no-stem-s2d", action="store_true",
+                       help="legacy checkpoints trained with "
+                            "--no-stem-s2d (param tree differs)")
+    p_inf.add_argument("--conv-backend", choices=["xla", "pallas"],
+                       help="legacy checkpoints trained with a non-default "
+                            "conv backend")
     p_inf.add_argument("--seg-out",
                        help="segment checkpoints: also write each part's "
                             "per-voxel label grid to this directory as "
@@ -241,11 +294,18 @@ def main(argv=None) -> None:
 
         from featurenet_tpu.config import get_config
         from featurenet_tpu.infer import Predictor, SegPrediction
+        from featurenet_tpu.train.checkpoint import load_run_config
 
-        over = (
-            {"resolution": args.resolution} if args.resolution else {}
-        )
-        cfg = get_config(args.config, **over)
+        saved = load_run_config(args.checkpoint_dir)
+        if saved is not None:
+            cfg = _cfg_from_checkpoint(saved, args)
+        else:
+            over = (
+                {"resolution": args.resolution} if args.resolution else {}
+            )
+            cfg = _apply_arch_overrides(
+                get_config(args.config or "pod64", **over), args
+            )
         if args.seg_out and cfg.task != "segment":
             raise SystemExit(
                 "--seg-out only applies to segmentation checkpoints "
@@ -287,11 +347,23 @@ def main(argv=None) -> None:
         jax.config.update("jax_debug_nans", True)
 
     from featurenet_tpu.config import get_config
+    from featurenet_tpu.train.checkpoint import load_run_config
     from featurenet_tpu.train.loop import Trainer
 
-    cfg = _apply_arch_overrides(
-        get_config(args.config, **_overrides(args)), args
+    saved = (
+        load_run_config(args.checkpoint_dir)
+        if getattr(args, "checkpoint_dir", None)
+        else None
     )
+    if saved is not None:
+        # Resume/eval of a run that persisted its config: the sidecar is
+        # the base; flags are policy overrides, identity contradictions are
+        # hard errors.
+        cfg = _cfg_from_checkpoint(saved, args)
+    else:
+        cfg = _apply_arch_overrides(
+            get_config(args.config or "pod64", **_overrides(args)), args
+        )
     print(json.dumps({"config": dataclasses.asdict(cfg)}, default=str))
     trainer = Trainer(cfg)
     if args.cmd == "train":

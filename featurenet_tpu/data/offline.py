@@ -139,7 +139,16 @@ def export_synthetic_cache(
     if resolution % 8:
         raise ValueError("resolution must be divisible by 8 (packed wire)")
     os.makedirs(out_root, exist_ok=True)
-    index = {"resolution": resolution, "classes": [], "counts": {}, "seed": seed}
+    index = {
+        "resolution": resolution,
+        "classes": [],
+        "counts": {},
+        "seed": seed,
+        # Canonical ids, explicit: the full canonical tree makes positional
+        # labels coincide with these anyway, but readers should never have
+        # to rely on that coincidence.
+        "label_ids": {cls: i for i, cls in enumerate(CLASS_NAMES)},
+    }
     for cls_id, cls in enumerate(CLASS_NAMES):
         rng = np.random.default_rng(
             np.random.SeedSequence([seed, cls_id])
@@ -413,9 +422,31 @@ class VoxelCacheDataset:
         # export_synthetic_cache's always-complete canonical tree) fall
         # back to position.
         self._grids = [grids[cls] for cls in self.index["classes"]]
-        label_ids = self.index.get("label_ids") or {
-            cls: pos for pos, cls in enumerate(self.index["classes"])
-        }
+        label_ids = self.index.get("label_ids")
+        if label_ids is None:
+            # Pre-label_ids cache: positional labels are only safe when the
+            # stored class order already agrees with the canonical ids —
+            # otherwise this is exactly the silent label permutation the
+            # label_ids field was added to kill (eval self-consistent,
+            # infer reports wrong names). Refuse, don't warn: the failure
+            # mode is invisible downstream.
+            mismatched = [
+                (pos, cls)
+                for pos, cls in enumerate(self.index["classes"])
+                if cls in CLASS_NAMES and CLASS_NAMES.index(cls) != pos
+            ]
+            if mismatched:
+                pos, cls = mismatched[0]
+                raise ValueError(
+                    f"cache {cache_root!r} predates the label_ids index "
+                    f"field and stores {cls!r} at position {pos} (canonical "
+                    f"id {CLASS_NAMES.index(cls)}); positional labels would "
+                    "silently permute class names. Rebuild the cache "
+                    "(`cli build-cache` / `cli export-data`)."
+                )
+            label_ids = {
+                cls: pos for pos, cls in enumerate(self.index["classes"])
+            }
         rows, labels, cls_pos = [], [], []
         for pos, cls in enumerate(self.index["classes"]):
             n = self._grids[pos].shape[0]

@@ -90,22 +90,39 @@ class Predictor:
     def from_checkpoint(
         cls,
         checkpoint_dir: str,
-        config: Config | str = "pod64",
+        config: Config | str | None = None,
         batch: int = 32,
     ) -> "Predictor":
         """Restore params/batch_stats from an Orbax run directory.
+
+        ``config=None`` (the default) reads the config persisted with the
+        checkpoint (``config.json``, written at save time) — the checkpoint
+        knows its own arch/resolution/task, so no flags are needed. An
+        explicit ``config`` must agree with the persisted identity fields
+        (hard error otherwise); for legacy dirs without the sidecar it is
+        the only source and falls back to the pod64 preset.
 
         The optimizer state in the checkpoint is restored (Orbax needs the
         full tree) and immediately dropped — inference keeps weights only.
         """
         import jax
 
-        from featurenet_tpu.train.checkpoint import CheckpointManager
+        from featurenet_tpu.config import check_identity
+        from featurenet_tpu.train.checkpoint import (
+            CheckpointManager,
+            load_run_config,
+        )
         from featurenet_tpu.train.state import create_state
         from featurenet_tpu.train.loop import build_model
         from featurenet_tpu.train.steps import make_optimizer
 
-        cfg = get_config(config) if isinstance(config, str) else config
+        saved = load_run_config(checkpoint_dir)
+        if config is None:
+            cfg = saved if saved is not None else get_config("pod64")
+        else:
+            cfg = get_config(config) if isinstance(config, str) else config
+            if saved is not None:
+                check_identity(saved, cfg)
         model = build_model(cfg)
         sample = np.zeros(
             (1, cfg.resolution, cfg.resolution, cfg.resolution, 1), np.float32
@@ -209,7 +226,11 @@ class Predictor:
                     SegPrediction(
                         path=path,
                         voxel_counts={
-                            CLASS_NAMES[c - 1]: int(counts[c])
+                            # A head wider than the canonical block (custom
+                            # num_classes) yields ids with no name — report
+                            # them numerically instead of IndexError-ing.
+                            (CLASS_NAMES[c - 1] if c - 1 < len(CLASS_NAMES)
+                             else f"class_{c - 1}"): int(counts[c])
                             for c in range(1, len(counts))
                             if counts[c]
                         },

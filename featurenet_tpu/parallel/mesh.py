@@ -51,6 +51,23 @@ def make_mesh(
     return Mesh(grid, axis_names=("data", "model"))
 
 
+def clamp_model_axis(model: int, n_devices: int) -> int:
+    """Largest divisor of ``n_devices`` that is ≤ ``model``.
+
+    Presets carry their pod-scale mesh shape (abc128 ships ``mesh_model=2``);
+    on hardware the axis doesn't divide — a single chip, a 6-device slice —
+    the run should degrade to the widest feasible model axis, not crash
+    (round-1 weak spot: the shipped stretch preset raised on the only chip
+    this environment has). Callers log the downgrade.
+    """
+    if model < 1:
+        raise ValueError(f"model axis must be >= 1, got {model}")
+    m = min(model, n_devices)
+    while n_devices % m:
+        m -= 1
+    return m
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -8,6 +8,7 @@ template, so a resumed multi-chip run comes back already distributed.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
@@ -16,18 +17,49 @@ import orbax.checkpoint as ocp
 
 from featurenet_tpu.train.state import TrainState
 
+# Run-config sidecar written into the checkpoint directory: the checkpoint's
+# identity (task/resolution/arch) travels with the weights, so eval/infer
+# self-configure instead of re-guessing flags (round-1 footgun class).
+CONFIG_FILENAME = "config.json"
+
+
+def load_run_config(directory: str):
+    """The ``Config`` persisted with a run, or ``None`` for legacy dirs."""
+    path = os.path.join(os.path.abspath(directory), CONFIG_FILENAME)
+    if not os.path.exists(path):
+        return None
+    from featurenet_tpu.config import config_from_dict
+
+    with open(path) as fh:
+        return config_from_dict(json.load(fh))
+
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3, config=None):
+        self._dir = os.path.abspath(directory)
+        self._config = config
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True, enable_async_checkpointing=True
             ),
         )
 
+    def _write_config(self) -> None:
+        if self._config is None or jax.process_index() != 0:
+            return
+        from featurenet_tpu.config import config_to_dict
+
+        path = os.path.join(self._dir, CONFIG_FILENAME)
+        tmp = path + ".tmp"  # atomic: a killed run must not leave half a file
+        with open(tmp, "w") as fh:
+            json.dump(config_to_dict(self._config), fh, indent=1, default=str)
+        os.replace(tmp, path)
+        self._config = None  # write once per manager
+
     def save(self, state: TrainState, step: Optional[int] = None) -> None:
         step = int(state.step) if step is None else step
+        self._write_config()
         payload = {
             "step": state.step,
             "params": state.params,

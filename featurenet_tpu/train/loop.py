@@ -20,6 +20,7 @@ import collections
 from typing import Optional
 
 import jax
+import numpy as np
 
 from featurenet_tpu.config import Config
 from featurenet_tpu.data.dataset import (
@@ -31,6 +32,7 @@ from featurenet_tpu.models.featurenet import FeatureNet
 from featurenet_tpu.models.segmenter import FeatureNetSegmenter
 from featurenet_tpu.parallel.mesh import (
     batch_shardings,
+    clamp_model_axis,
     make_mesh,
     replicated,
     state_shardings,
@@ -55,9 +57,23 @@ def build_model(cfg: Config):
 class Trainer:
     def __init__(self, cfg: Config, mesh=None, spatial: Optional[bool] = None):
         self.cfg = cfg.validate()
-        self.mesh = mesh if mesh is not None else make_mesh(
-            cfg.mesh_data, cfg.mesh_model
-        )
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            model = clamp_model_axis(cfg.mesh_model, len(jax.devices()))
+            if model != cfg.mesh_model:
+                # Presets carry pod-scale mesh shapes; on smaller hardware
+                # degrade to the widest feasible model axis instead of
+                # refusing to start.
+                import json as _json
+                import sys as _sys
+
+                print(_json.dumps({
+                    "mesh_warning": f"mesh_model={cfg.mesh_model} does not "
+                    f"divide the {len(jax.devices())} available device(s); "
+                    f"running with mesh_model={model}",
+                }), file=_sys.stderr)
+            self.mesh = make_mesh(cfg.mesh_data, model)
         self.spatial = cfg.spatial if spatial is None else spatial
         self.model = build_model(cfg)
         self.tx = make_optimizer(cfg)
@@ -215,7 +231,9 @@ class Trainer:
 
         self.ckpt: Optional[CheckpointManager] = None
         if cfg.checkpoint_dir:
-            self.ckpt = CheckpointManager(cfg.checkpoint_dir, cfg.keep_checkpoints)
+            self.ckpt = CheckpointManager(
+                cfg.checkpoint_dir, cfg.keep_checkpoints, config=cfg
+            )
 
     def _heartbeat(self) -> None:
         """Record confirmed progress for an external supervisor.
@@ -249,9 +267,19 @@ class Trainer:
         sums = []
         for host_batch in batches:
             batch = put_batch(host_batch, self.batch_sh)
-            sums.append(self._eval_step(
+            s = self._eval_step(
                 self.state.params, self.state.batch_stats, batch
-            ))
+            )
+            sums.append(s)
+            if self.cfg.heartbeat_file:
+                # A full held-out pass can exceed the supervisor's stall
+                # timeout; without per-batch beats it kills a healthy run
+                # mid-eval, resumes, hits the same eval, and burns every
+                # restart. Each beat follows a device→host readback —
+                # dispatch alone proves nothing on a hung backend (and on
+                # this tunnel block_until_ready can return early).
+                np.asarray(jax.tree_util.tree_leaves(s)[0])
+                self._heartbeat()
         return aggregate_eval(jax.block_until_ready(sums))
 
     def run(self, num_steps: Optional[int] = None) -> dict:

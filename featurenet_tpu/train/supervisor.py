@@ -92,6 +92,12 @@ def supervise(
     grace = grace_s if grace_s is not None else max(stall_timeout_s, 600.0)
 
     restarts = stalls = 0
+    # Consecutive nonzero exits before any heartbeat: a child that dies
+    # during startup (argparse error, missing cache dir, out-of-range label)
+    # is deterministic — retrying it max_restarts times pays full JAX/device
+    # init each round for the same exit. One retry tolerates a transient
+    # (tunnel lease mid-release); two in a row is permanent.
+    early_fails = 0
     while True:
         # Fresh heartbeat so a stale file from the previous child can't
         # trigger (or mask) a stall verdict for this one. Its mtime is the
@@ -110,7 +116,16 @@ def supervise(
             if rc is not None:
                 break
             time.sleep(poll_s)
-            mtime = os.path.getmtime(heartbeat_file)
+            try:
+                mtime = os.path.getmtime(heartbeat_file)
+            except OSError:
+                # Deleted externally (a /tmp cleaner on a multi-day run):
+                # recreate rather than crash — a dead supervisor leaves the
+                # detached child running unsupervised. Resetting the
+                # baseline keeps first-beat detection honest; the stall
+                # clock restarts from now.
+                touch_heartbeat(heartbeat_file)
+                mtime = base_mtime = os.path.getmtime(heartbeat_file)
             age = time.time() - mtime
             if not first_beat_seen:
                 if mtime > base_mtime:
@@ -127,10 +142,32 @@ def supervise(
                 _kill_tree(proc)
                 rc = proc.returncode
                 break
+        if not first_beat_seen:
+            # The final beat may have landed inside the last poll window
+            # (poll sleeps, then the loop breaks on proc.poll() without
+            # re-sampling) — re-read before classifying this exit as a
+            # startup failure, or a crash seconds after real progress gets
+            # the permanent-failure treatment.
+            try:
+                first_beat_seen = os.path.getmtime(heartbeat_file) > base_mtime
+            except OSError:
+                pass
         if not stalled and rc == 0:
             log(json.dumps({"supervisor": "done", "restarts": restarts,
                             "stalls": stalls}))
             return SuperviseResult(0, restarts, stalls)
+        if not stalled and not first_beat_seen:
+            early_fails += 1
+            if early_fails >= 2:
+                log(json.dumps({
+                    "supervisor": "giving_up",
+                    "reason": f"exit_{rc} before first heartbeat, twice — "
+                              "deterministic startup failure",
+                    "restarts": restarts, "stalls": stalls,
+                }))
+                return SuperviseResult(rc if rc else 1, restarts, stalls)
+        else:
+            early_fails = 0
         stalls += int(stalled)
         restarts += 1
         if restarts > max_restarts:

@@ -1,0 +1,159 @@
+"""Run-config persistence: the checkpoint knows its own identity.
+
+Round-1 footgun class under test: a checkpoint restored under guessed flags
+(wrong arch/resolution/task) either fails cryptically or — worse — restores
+structurally and predicts nonsense. The sidecar `config.json` written by
+`CheckpointManager.save` plus `check_identity` closes every path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from featurenet_tpu.config import (
+    PRESETS,
+    check_identity,
+    config_from_dict,
+    config_to_dict,
+    get_config,
+)
+
+
+@pytest.mark.parametrize("name", sorted(PRESETS))
+def test_config_json_roundtrip(name):
+    cfg = get_config(name)
+    d = json.loads(json.dumps(config_to_dict(cfg)))  # through real JSON
+    assert config_from_dict(d) == cfg
+
+
+def test_config_from_dict_drops_unknown_and_defaults_missing():
+    d = config_to_dict(get_config("smoke16"))
+    d["from_the_future"] = 123
+    d["arch"]["also_new"] = True
+    del d["eval_batches"]
+    cfg = config_from_dict(d)
+    assert cfg.name == "smoke16"
+    # A missing field takes the dataclass default (forward compatibility).
+    from featurenet_tpu.config import Config
+
+    assert cfg.eval_batches == Config().eval_batches
+
+
+def test_check_identity_passes_on_equal_and_raises_on_mismatch():
+    a = get_config("smoke16")
+    check_identity(a, get_config("smoke16"))  # no raise
+    with pytest.raises(ValueError, match="resolution"):
+        check_identity(a, get_config("smoke16", resolution=32))
+    with pytest.raises(ValueError, match="arch"):
+        check_identity(
+            a,
+            dataclasses.replace(
+                a, arch=dataclasses.replace(a.arch, stem_s2d=False)
+            ),
+        )
+
+
+def _train_briefly(tmp_path, **over):
+    from featurenet_tpu.train.loop import Trainer
+
+    cfg = get_config(
+        "smoke16",
+        total_steps=2,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=2,
+        eval_every=10**9,
+        log_every=10**9,
+        data_workers=1,
+        **over,
+    )
+    t = Trainer(cfg)
+    t.run()
+    return cfg
+
+
+def test_sidecar_written_and_predictor_self_configures(tmp_path):
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.train.checkpoint import load_run_config
+
+    cfg = _train_briefly(tmp_path)
+    path = tmp_path / "ckpt" / "config.json"
+    assert path.exists()
+    loaded = load_run_config(str(tmp_path / "ckpt"))
+    assert loaded == cfg
+
+    # No flags, no guessing: the Predictor reads the sidecar.
+    p = Predictor.from_checkpoint(str(tmp_path / "ckpt"), batch=2)
+    assert p.cfg.resolution == 16
+    assert p.cfg.name == "smoke16"
+    grids = np.zeros((1, 16, 16, 16), np.float32)
+    labels, probs = p.predict_voxels(grids)
+    assert labels.shape == (1,)
+    assert probs.shape[1] == p.cfg.arch.num_classes
+
+
+def test_predictor_rejects_contradicting_explicit_config(tmp_path):
+    from featurenet_tpu.infer import Predictor
+
+    _train_briefly(tmp_path)
+    with pytest.raises(ValueError, match="contradict"):
+        Predictor.from_checkpoint(
+            str(tmp_path / "ckpt"), config=get_config("pod64"), batch=2
+        )
+
+
+def test_cli_eval_uses_sidecar_and_rejects_mismatched_flags(tmp_path, capsys):
+    from featurenet_tpu import cli
+
+    _train_briefly(tmp_path)
+    ckpt = str(tmp_path / "ckpt")
+    # No --config at all: the sidecar supplies smoke16 (default used to be
+    # pod64 — this is the "self-configuring" acceptance case).
+    cli.main(["eval", "--checkpoint-dir", ckpt, "--data-workers", "1"])
+    out = capsys.readouterr().out
+    assert '"eval"' in out
+    assert '"smoke16"' in out
+    # An explicitly contradicting identity flag is a hard error.
+    with pytest.raises(SystemExit, match="contradict"):
+        cli.main([
+            "eval", "--checkpoint-dir", ckpt, "--resolution", "32",
+        ])
+    with pytest.raises(SystemExit, match="contradict"):
+        cli.main([
+            "eval", "--checkpoint-dir", ckpt, "--config", "pod64",
+        ])
+
+
+def test_cli_train_resume_reads_sidecar(tmp_path, capsys):
+    """Resume without flags continues the persisted config, not pod64."""
+    from featurenet_tpu import cli
+
+    _train_briefly(tmp_path)
+    capsys.readouterr()  # drain the setup run's own log lines
+    ckpt = str(tmp_path / "ckpt")
+    cli.main([
+        "train", "--checkpoint-dir", ckpt, "--total-steps", "3",
+        "--data-workers", "1",
+    ])
+    out = capsys.readouterr().out
+    cfg_line = json.loads(out.splitlines()[0])
+    assert cfg_line["config"]["name"] == "smoke16"
+    assert cfg_line["config"]["total_steps"] == 3  # policy override applied
+
+
+def test_sidecar_scrubs_ephemeral_fields(tmp_path):
+    from featurenet_tpu.cli import _cfg_from_checkpoint
+
+    cfg = _train_briefly(tmp_path, heartbeat_file=str(tmp_path / "hb"))
+
+    class _Args:
+        pass
+
+    got = _cfg_from_checkpoint(cfg, _Args())
+    assert got.heartbeat_file is None
+    assert got.tb_dir is None
+    assert got.profile_dir is None

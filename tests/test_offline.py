@@ -254,3 +254,34 @@ def test_trainer_refuses_out_of_range_cache_labels(stl_tree, tmp_path):
                      total_steps=1, data_workers=1)
     with pytest.raises(ValueError, match="label id 2[45]"):
         Trainer(cfg)
+
+
+def test_legacy_cache_with_permuting_order_is_refused(tmp_path):
+    """Pre-label_ids caches whose positional order disagrees with the
+    canonical ids must be refused: positional labels would silently permute
+    (eval self-consistent, infer wrong) — the round-1 disease."""
+    out = str(tmp_path / "cache")
+    export_synthetic_cache(out, per_class=2, resolution=16)
+    with open(os.path.join(out, "index.json")) as fh:
+        index = json.load(fh)
+    del index["label_ids"]  # simulate a pre-fix cache…
+    index["classes"] = index["classes"][::-1]  # …stored in a permuted order
+    with open(os.path.join(out, "index.json"), "w") as fh:
+        json.dump(index, fh)
+    with pytest.raises(ValueError, match="permute"):
+        VoxelCacheDataset(out, global_batch=4, split="train")
+
+
+def test_legacy_cache_in_canonical_order_still_loads(tmp_path):
+    """Old caches whose order already matches the canonical ids keep
+    working via the positional fallback."""
+    out = str(tmp_path / "cache")
+    export_synthetic_cache(out, per_class=2, resolution=16)
+    with open(os.path.join(out, "index.json")) as fh:
+        index = json.load(fh)
+    del index["label_ids"]
+    with open(os.path.join(out, "index.json"), "w") as fh:
+        json.dump(index, fh)
+    ds = VoxelCacheDataset(out, global_batch=4, split="train")
+    from featurenet_tpu.data.synthetic import CLASS_NAMES
+    assert ds.labels.max() == len(CLASS_NAMES) - 1

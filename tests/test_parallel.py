@@ -148,3 +148,30 @@ def test_bn_stats_are_global_batch(rng):
         # level here; 1e-4 cleanly separates semantics from summation order.)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_clamp_model_axis():
+    from featurenet_tpu.parallel.mesh import clamp_model_axis
+
+    assert clamp_model_axis(1, 1) == 1
+    assert clamp_model_axis(2, 1) == 1  # abc128 preset on a single chip
+    assert clamp_model_axis(2, 8) == 2
+    assert clamp_model_axis(3, 8) == 2  # largest divisor <= requested
+    assert clamp_model_axis(5, 8) == 4
+    assert clamp_model_axis(16, 8) == 8
+    assert clamp_model_axis(2, 6) == 2
+    assert clamp_model_axis(4, 6) == 3
+    with pytest.raises(ValueError):
+        clamp_model_axis(0, 8)
+
+
+def test_trainer_clamps_nondividing_model_axis(capsys):
+    """A preset whose mesh_model doesn't divide the device count starts
+    anyway on the widest feasible axis (round-1: abc128 crashed on 1 chip)."""
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.train.loop import Trainer
+
+    cfg = get_config("smoke16", mesh_model=3, data_workers=1)
+    t = Trainer(cfg)
+    assert t.mesh.shape == {"data": 4, "model": 2}
+    assert "mesh_warning" in capsys.readouterr().err

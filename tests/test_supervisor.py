@@ -97,11 +97,19 @@ def _any_descendant_running(code_fragment: str) -> bool:
 
 
 def test_gives_up_after_max_restarts(tmp_path):
+    # The child beats first so the crash counts as a *run* failure (startup
+    # failures short-circuit after two attempts — tested separately).
+    hb = tmp_path / "hb"
+    code = (
+        "import os, sys, time\n"
+        f"hb={str(hb)!r}\n"
+        "time.sleep(0.2); os.utime(hb, None); time.sleep(0.2); sys.exit(7)\n"
+    )
     res = supervise(
-        _child("import sys; sys.exit(7)"),
+        _child(code),
         stall_timeout_s=5,
         max_restarts=2,
-        heartbeat_file=str(tmp_path / "hb"),
+        heartbeat_file=str(hb),
         poll_s=0.05,
         log=lambda _: None,
     )
@@ -124,3 +132,89 @@ def test_child_argv_strips_supervision_flags():
     assert not any(a.startswith("--max-restarts") for a in tail)
     assert tail[-2:] == ["--heartbeat-file", "/tmp/hb"]
     assert "--checkpoint-dir" in tail and "runs/x" in tail
+
+
+def test_startup_failure_is_permanent_after_two_attempts(tmp_path):
+    """A child that dies before its first heartbeat is a deterministic
+    startup failure — one retry tolerates a transient, two ends the run
+    instead of burning max_restarts full JAX inits."""
+    attempts = tmp_path / "attempts"
+    code = (
+        f"import sys; a={str(attempts)!r}\n"
+        "open(a, 'a').write('x'); sys.exit(3)\n"
+    )
+    res = supervise(
+        _child(code),
+        stall_timeout_s=5,
+        max_restarts=10,
+        heartbeat_file=str(tmp_path / "hb"),
+        poll_s=0.05,
+        log=lambda _: None,
+    )
+    assert res.exit_code == 3
+    assert attempts.read_text() == "xx"  # exactly two attempts, not eleven
+    assert res.restarts == 1
+
+
+def test_startup_failure_counter_resets_after_a_beat(tmp_path):
+    """Crashes *after* a heartbeat are run failures, not startup failures —
+    they keep the full restart budget."""
+    attempts = tmp_path / "attempts"
+    hb = tmp_path / "hb"
+    code = (
+        "import os, sys, time\n"
+        f"a={str(attempts)!r}; hb={str(hb)!r}\n"
+        "n = len(open(a).read()) if os.path.exists(a) else 0\n"
+        "open(a, 'a').write('x')\n"
+        "time.sleep(0.3); os.utime(hb, None)  # beat\n"
+        "sys.exit(0 if n >= 3 else 5)\n"
+    )
+    res = supervise(
+        _child(code),
+        stall_timeout_s=10,
+        max_restarts=5,
+        heartbeat_file=str(hb),
+        poll_s=0.05,
+        log=lambda _: None,
+    )
+    assert res.exit_code == 0
+    assert res.restarts == 3
+
+
+def test_deleted_heartbeat_file_is_recreated_not_fatal(tmp_path):
+    """An external /tmp cleaner deleting the heartbeat must not kill the
+    supervisor (which would orphan the detached child)."""
+    import threading
+    import time as _time
+
+    hb = tmp_path / "hb"
+    code = (
+        # create-or-touch (the real Trainer's touch_heartbeat semantics):
+        # a bare os.utime would crash if the touch lands in the window
+        # between the deleter's unlink and the supervisor's recreation.
+        "import os, time\n"
+        f"hb={str(hb)!r}\n"
+        "for _ in range(20):\n"
+        "    open(hb, 'a').close(); os.utime(hb, None); time.sleep(0.1)\n"
+    )
+
+    def deleter():
+        _time.sleep(0.6)
+        try:
+            os.unlink(hb)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=deleter)
+    t.start()
+    res = supervise(
+        _child(code),
+        stall_timeout_s=10,
+        max_restarts=1,
+        heartbeat_file=str(hb),
+        poll_s=0.1,
+        log=lambda _: None,
+    )
+    t.join()
+    assert res.exit_code == 0
+    assert res.restarts == 0
