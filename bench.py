@@ -1,7 +1,8 @@
 """Benchmark: 64³-voxel training throughput, samples/sec/chip (BASELINE.json).
 
-Runs the pod64 flagship config's compiled train step on all visible devices
-(one real TPU chip under the driver) and prints ONE JSON line:
+Driver entry point: runs the pod64 flagship config's compiled train step on
+all visible devices (one real TPU chip under the driver) and prints ONE JSON
+line:
 
     {"metric": "...", "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
 
@@ -11,102 +12,35 @@ samples/sec" which cannot be measured here. We use a documented, conservative
 stand-in: 330 samples/sec for FeatureNet-64³ on a V100 (fp32 cuDNN, batch 96 —
 derived in BASELINE.md; flagged as estimated). vs_baseline = measured / 330.
 
-Method: jit the full train step (fwd+bwd+optimizer+BN) at global batch 128,
-warm up, then *slope timing*: wall (1 step + loss transfer) and (N+1 steps +
-loss transfer); per-step time = (t_long - t_short)/N. The final scalar
-transfer is the sync point — on this environment's tunneled TPU backend,
-``block_until_ready`` returns before device execution completes, so only a
-device→host readback is an honest wall; the slope subtracts the constant
-round-trip latency from the measurement.
+The MFU fields (analytic matmul FLOPs from ``ops/flops.py`` over the v5e
+197 TF/s bf16 peak) make "distance from ceiling" checkable from this artifact
+alone. Measurement core: ``featurenet_tpu.benchmark.measure_train_step``
+(slope-timed; see its docstring); ``featurenet_tpu.ops.bench_arch`` sweeps
+architecture variants with the same core.
 """
 
 from __future__ import annotations
 
 import json
-import time
 
-import numpy as np
-
-V100_SAMPLES_PER_SEC_EST = 330.0  # documented estimate, see BASELINE.md
-# Per-chip batch: XLA pads the batch dim to multiples of 128 (measured —
-# batch 96 and 128 take the same 53 ms step), so bench at the multiple;
-# this is also the pod64 preset's training batch.
-BATCH = 128
-WARMUP, MEASURE = 5, 20
+from featurenet_tpu.benchmark import V100_SAMPLES_PER_SEC_EST, measure_train_step
 
 
 def main() -> None:
-    import jax
-
     from featurenet_tpu.config import get_config
-    from featurenet_tpu.data.synthetic import WIRE_KEYS, generate_batch, to_wire
-    from featurenet_tpu.models import FeatureNet
-    from featurenet_tpu.parallel.mesh import (
-        batch_shardings,
-        make_mesh,
-        replicated,
-        state_shardings,
-    )
-    from featurenet_tpu.train.state import create_state
-    from featurenet_tpu.train.steps import make_optimizer, make_train_step
 
-    n_chips = len(jax.devices())
-    mesh = make_mesh()  # all devices on 'data'
-    cfg = get_config("pod64")
-    # Per-chip batch stays BATCH regardless of chip count (weak scaling).
-    global_batch = BATCH * mesh.shape["data"]
-
-    model = FeatureNet(arch=cfg.arch)
-    tx = make_optimizer(cfg)
-
-    def init_fn(rng):
-        import jax.numpy as jnp
-
-        sample = jnp.zeros((global_batch, 64, 64, 64, 1), jnp.float32)
-        return create_state(model, tx, sample, rng)
-
-    abstract = jax.eval_shape(init_fn, jax.random.key(0))
-    st_sh = state_shardings(abstract, mesh)
-    state = jax.jit(init_fn, out_shardings=st_sh)(jax.random.key(0))
-
-    # The real classify wire format: bit-packed voxels, no per-voxel target,
-    # unpacked on device inside the compiled step.
-    b_sh = batch_shardings(mesh, keys=WIRE_KEYS["classify"])
-    step = jax.jit(
-        make_train_step(model, "classify", packed=True),
-        in_shardings=(st_sh, b_sh, replicated(mesh)),
-        out_shardings=(st_sh, replicated(mesh)),
-        donate_argnums=(0,),
-    )
-
-    host = to_wire(
-        generate_batch(np.random.default_rng(0), global_batch, 64), "classify"
-    )
-    batch = jax.device_put(host, b_sh)
-    rng = jax.device_put(jax.random.key(1), replicated(mesh))
-
-    for _ in range(WARMUP):
-        state, metrics = step(state, batch, rng)
-    float(metrics["loss"])  # drain the pipe
-
-    def walled(k: int) -> float:
-        nonlocal state
-        t0 = time.perf_counter()
-        for _ in range(k):
-            state, metrics = step(state, batch, rng)
-        float(metrics["loss"])  # device→host readback = honest sync
-        return time.perf_counter() - t0
-
-    t_short = walled(1)
-    t_long = walled(1 + MEASURE)
-    per_step = (t_long - t_short) / MEASURE
-    sps = global_batch / per_step
-    sps_chip = sps / n_chips
+    m = measure_train_step(get_config("pod64"))
     print(json.dumps({
         "metric": "featurenet64_train_throughput",
-        "value": round(sps_chip, 2),
+        "value": m["samples_per_sec_per_chip"],
         "unit": "samples/sec/chip",
-        "vs_baseline": round(sps_chip / V100_SAMPLES_PER_SEC_EST, 3),
+        "vs_baseline": round(
+            m["samples_per_sec_per_chip"] / V100_SAMPLES_PER_SEC_EST, 3
+        ),
+        "gflops_per_sample": m["gflops_per_sample"],
+        "tflops_per_sec_per_chip": m["tflops_per_sec_per_chip"],
+        "mfu": m["mfu"],
+        "mfu_peak_tflops": m["mfu_peak_tflops"],
     }))
 
 

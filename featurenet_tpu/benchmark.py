@@ -1,0 +1,118 @@
+"""Shared train-step throughput measurement (the bench core).
+
+Lives inside the package so both the driver's root-level ``bench.py`` and
+``featurenet_tpu.ops.bench_arch`` (the architecture sweep) import it without
+depending on the repo root being on sys.path.
+
+Method — slope timing: jit the full train step (fwd+bwd+optimizer+BN), warm
+up, then wall (1 step + loss transfer) and (N+1 steps + loss transfer);
+per-step time = (t_long - t_short)/N. The final scalar transfer is the sync
+point — on this environment's tunneled TPU backend, ``block_until_ready``
+returns before device execution completes, so only a device→host readback is
+an honest wall; the slope subtracts the constant round-trip latency.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+V100_SAMPLES_PER_SEC_EST = 330.0  # documented estimate, see BASELINE.md
+# Per-chip batch: XLA pads the batch dim to multiples of 128 (measured —
+# batch 96 and 128 take the same 53 ms step), so bench at the multiple;
+# this is also the pod64 preset's training batch.
+BATCH = 128
+WARMUP, MEASURE = 5, 20
+
+def measure_train_step(
+    cfg, batch_per_chip: int = BATCH, warmup: int = WARMUP,
+    measure: int = MEASURE,
+) -> dict:
+    """Slope-time the compiled train step for ``cfg`` on all devices.
+
+    Returns per-chip throughput plus the analytic-MFU fields. Weak scaling:
+    the per-chip batch stays fixed regardless of chip count.
+    """
+    import jax
+
+    from featurenet_tpu.data.synthetic import (
+        WIRE_KEYS,
+        generate_batch,
+        to_wire,
+    )
+    from featurenet_tpu.models import FeatureNet
+    from featurenet_tpu.ops.flops import (
+        PEAK_BF16_FLOPS,
+        mfu,
+        train_step_flops_per_sample,
+    )
+    from featurenet_tpu.parallel.mesh import (
+        batch_shardings,
+        make_mesh,
+        replicated,
+        state_shardings,
+    )
+    from featurenet_tpu.train.state import create_state
+    from featurenet_tpu.train.steps import make_optimizer, make_train_step
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh()  # all devices on 'data'
+    global_batch = batch_per_chip * mesh.shape["data"]
+    R = cfg.resolution
+
+    model = FeatureNet(arch=cfg.arch)
+    tx = make_optimizer(cfg)
+
+    def init_fn(rng):
+        import jax.numpy as jnp
+
+        sample = jnp.zeros((global_batch, R, R, R, 1), jnp.float32)
+        return create_state(model, tx, sample, rng)
+
+    abstract = jax.eval_shape(init_fn, jax.random.key(0))
+    st_sh = state_shardings(abstract, mesh)
+    state = jax.jit(init_fn, out_shardings=st_sh)(jax.random.key(0))
+
+    # The real classify wire format: bit-packed voxels, no per-voxel target,
+    # unpacked on device inside the compiled step.
+    b_sh = batch_shardings(mesh, keys=WIRE_KEYS["classify"])
+    step = jax.jit(
+        make_train_step(model, "classify", packed=True),
+        in_shardings=(st_sh, b_sh, replicated(mesh)),
+        out_shardings=(st_sh, replicated(mesh)),
+        donate_argnums=(0,),
+    )
+
+    host = to_wire(
+        generate_batch(np.random.default_rng(0), global_batch, R), "classify"
+    )
+    batch = jax.device_put(host, b_sh)
+    rng = jax.device_put(jax.random.key(1), replicated(mesh))
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])  # drain the pipe
+
+    def walled(k: int) -> float:
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, metrics = step(state, batch, rng)
+        float(metrics["loss"])  # device→host readback = honest sync
+        return time.perf_counter() - t0
+
+    t_short = walled(1)
+    t_long = walled(1 + measure)
+    per_step = (t_long - t_short) / measure
+    sps_chip = global_batch / per_step / n_chips
+    fps = train_step_flops_per_sample(cfg.arch, R)
+    return {
+        "batch_per_chip": batch_per_chip,
+        "per_step_ms": round(per_step * 1e3, 2),
+        "samples_per_sec_per_chip": round(sps_chip, 2),
+        "gflops_per_sample": round(fps / 1e9, 2),
+        "tflops_per_sec_per_chip": round(sps_chip * fps / 1e12, 1),
+        "mfu": round(mfu(sps_chip, fps), 3),
+        "mfu_peak_tflops": PEAK_BF16_FLOPS / 1e12,
+    }

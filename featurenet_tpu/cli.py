@@ -45,8 +45,10 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="use the direct strided conv instead of the "
                         "space-to-depth stem (matches checkpoints trained "
                         "with stem_s2d=False)")
-    p.add_argument("--conv-backend", choices=["xla", "pallas"],
+    p.add_argument("--conv-backend", choices=["xla", "pallas", "hybrid_dw"],
                    help="backend for stride-1 conv blocks (default xla)")
+    p.add_argument("--seg-loss", choices=["balanced_ce", "ce_dice", "dice"],
+                   help="segmentation loss variant (default balanced_ce)")
     p.add_argument("--debug-nans", action="store_true",
                    help="jax_debug_nans: fail fast on the op producing a NaN")
 
@@ -73,7 +75,7 @@ def _overrides(args) -> dict:
     keys = [
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
-        "profile_dir", "tb_dir", "heartbeat_file",
+        "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
     ]
     out = {
         k: getattr(args, k, None)
@@ -193,7 +195,7 @@ def main(argv=None) -> None:
     p_inf.add_argument("--no-stem-s2d", action="store_true",
                        help="legacy checkpoints trained with "
                             "--no-stem-s2d (param tree differs)")
-    p_inf.add_argument("--conv-backend", choices=["xla", "pallas"],
+    p_inf.add_argument("--conv-backend", choices=["xla", "pallas", "hybrid_dw"],
                        help="legacy checkpoints trained with a non-default "
                             "conv backend")
     p_inf.add_argument("--seg-out",

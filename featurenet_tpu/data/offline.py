@@ -260,12 +260,29 @@ def _hash_split_rows(n: int, split: str, test_fraction: float) -> np.ndarray:
     return np.nonzero(keep)[0].astype(np.int64)
 
 
-def _epoch_index_batches(n: int, batch: int):
+def _epoch_index_batches(
+    n: int, batch: int, num_shards: int = 1, shard_id: int = 0
+):
     """Exact-pass index batches; the final partial batch wraps to the front
     with mask=0 rows so masked sums count every sample exactly once while
-    batch shapes stay static. Shared by both cache datasets."""
-    for start in range(0, n, batch):
-        idx = np.arange(start, min(start + batch, n))
+    batch shapes stay static. Shared by both cache datasets.
+
+    ``num_shards``/``shard_id`` decimate the pass for multi-host eval: shard
+    ``i`` takes samples ``i, i+num_shards, …`` — every sample lands in
+    exactly one shard, so when each host feeds its shard into its slice of
+    the global eval batch the globally-reduced masked sums count each
+    held-out sample exactly once (instead of ``process_count`` times, the
+    round-1 redundancy). All shards yield the same number of batches —
+    required, because hosts dispatch the jitted eval step in lockstep.
+    """
+    if n <= 0:  # constructors refuse empty splits; belt and braces here
+        raise ValueError("epoch over an empty split")
+    mine = np.arange(shard_id, n, num_shards, dtype=np.int64)
+    # ceil over the *largest* shard so every host emits equally many batches.
+    largest = (n + num_shards - 1) // num_shards
+    n_batches = max((largest + batch - 1) // batch, 1)
+    for b in range(n_batches):
+        idx = mine[b * batch:(b + 1) * batch]
         mask = np.ones(batch, dtype=np.float32)
         if len(idx) < batch:
             mask[len(idx):] = 0.0
@@ -366,9 +383,14 @@ class SegCacheDataset:
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         return self.worker_iter(0, 1)
 
-    def epoch_batches(self, batch: int) -> Iterator[dict[str, np.ndarray]]:
-        """One exact pass; the final partial batch wraps with mask=0 rows."""
-        for idx, mask in _epoch_index_batches(len(self.rows), batch):
+    def epoch_batches(
+        self, batch: int, num_shards: int = 1, shard_id: int = 0
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """One exact pass; the final partial batch wraps with mask=0 rows.
+        ``num_shards``/``shard_id`` split the pass disjointly (multi-host)."""
+        for idx, mask in _epoch_index_batches(
+            len(self.rows), batch, num_shards, shard_id
+        ):
             v, s = self._gather(idx)
             yield {"voxels": v, "seg": s, "mask": mask}
 
@@ -498,14 +520,21 @@ class VoxelCacheDataset:
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         return self.worker_iter(0, 1)
 
-    def epoch_batches(self, batch: int) -> Iterator[dict[str, np.ndarray]]:
+    def epoch_batches(
+        self, batch: int, num_shards: int = 1, shard_id: int = 0
+    ) -> Iterator[dict[str, np.ndarray]]:
         """One exact pass over the split, every sample exactly once.
 
         The final partial batch is padded (wrapping to the front) with
         ``mask=0`` rows, so downstream masked sums count each held-out
         sample exactly once while batch shapes stay static.
+        ``num_shards``/``shard_id`` split the pass disjointly (multi-host
+        eval: each host feeds only its shard, globally reduced sums still
+        count every sample once).
         """
-        for idx, mask in _epoch_index_batches(len(self.labels), batch):
+        for idx, mask in _epoch_index_batches(
+            len(self.labels), batch, num_shards, shard_id
+        ):
             yield {
                 "voxels": self._gather(idx),
                 "label": self.labels[idx],

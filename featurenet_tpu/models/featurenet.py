@@ -66,8 +66,11 @@ class FeatureNetArch:
     # lowering — the two settings have different param tree paths, so pick
     # per run, not per restore.
     stem_s2d: bool = True
-    # Backend for the stride-1 conv blocks: "xla" (default — measured
-    # fastest, BASELINE.md) or "pallas" (ops/conv3d.py, fp32).
+    # Backend for the stride-1 conv blocks: "xla" (default), "pallas"
+    # (ops/conv3d.py, fp32 all-Pallas reference), or "hybrid_dw" (XLA
+    # fwd/dx + the Pallas tap-folded weight-grad kernel, ops/conv_dw.py —
+    # targets the Cout-starved dW contraction, the measured pod64
+    # bottleneck). The microbench (ops/bench_ops.py) re-decides defaults.
     conv_backend: str = "xla"
     # Head: flatten (paper-shape; correct for the shallow 64³ stack) or
     # global-average-pool (deep stacks: a flattened 8³×256 head is 33M
@@ -145,6 +148,10 @@ class ConvBNRelu(nn.Module):
             from featurenet_tpu.ops.conv3d import PallasConv
 
             x = PallasConv(self.features, self.kernel, dtype=self.dtype)(x)
+        elif self.stride == 1 and self.conv_backend == "hybrid_dw":
+            from featurenet_tpu.ops.conv3d import HybridConv
+
+            x = HybridConv(self.features, self.kernel, dtype=self.dtype)(x)
         else:
             x = nn.Conv(
                 self.features,

@@ -1,0 +1,58 @@
+"""Architecture-variant throughput sweep (the round-2 ceiling attack).
+
+Round-1 profiling pinned the pod64 step's cost on the conv2 5³ weight-grad:
+a [125·32, B·32³, 32]-shaped contraction whose C_out=32 fills 32/128 MXU
+columns (~25% shape ceiling, BASELINE.md "where the milliseconds go"). Two
+levers follow, both expressible as arch configs without touching the model:
+
+- **k3**: shrink conv2's kernel 5³→3³ (FLOPs ×27/125 on the dominant block).
+  The 5³ window was a 2018 GPU-era choice; at 64³ with a s2 stem in front,
+  the effective receptive field loss is small — accuracy must be (and is)
+  re-validated on the full benchmark before this becomes a preset.
+- **wide**: double channels (C_out ≥ 64) so the dW contraction fills ≥50%
+  of the MXU — more FLOPs/sample but run at proportionally better
+  efficiency; the MFU row quantifies the shape ceiling directly.
+
+Run on the real chip: ``python -m featurenet_tpu.ops.bench_arch``
+(one JSON line per variant × batch; ~1 min total).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from featurenet_tpu.config import get_config
+from featurenet_tpu.models.featurenet import FeatureNetArch
+
+
+VARIANTS = {
+    "paper": FeatureNetArch(),
+    "paper_hybrid_dw": dataclasses.replace(
+        FeatureNetArch(), conv_backend="hybrid_dw"
+    ),
+    "k3": dataclasses.replace(FeatureNetArch(), kernels=(7, 3, 3, 3)),
+    "wide": dataclasses.replace(
+        FeatureNetArch(), features=(64, 64, 128, 128)
+    ),
+    "wide_k3": dataclasses.replace(
+        FeatureNetArch(), features=(64, 64, 128, 128), kernels=(7, 3, 3, 3)
+    ),
+}
+
+
+def main(batches=(128, 256), variants=None) -> list[dict]:
+    from featurenet_tpu.benchmark import measure_train_step
+
+    rows = []
+    for name, arch in (variants or VARIANTS).items():
+        for b in batches:
+            cfg = dataclasses.replace(get_config("pod64"), arch=arch)
+            row = {"variant": name, **measure_train_step(cfg, b)}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

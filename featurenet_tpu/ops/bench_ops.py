@@ -121,6 +121,44 @@ def main() -> None:
             row["pallas_vs_xla"] = round(t_xla / t_pal, 2)
         print(json.dumps(row))
 
+    # --- conv2 weight grad: XLA VJP vs the tap-folded Pallas kernel ---------
+    # The measured pod64 bottleneck (BASELINE.md: ~18 ms, ~60 TF/s — Cout=32
+    # fills 32/128 MXU columns). conv_dw_folded moves k x-taps onto the
+    # column side (N = k·Cout); both paths accumulate fp32 from bf16 inputs,
+    # matching the real training step's dtypes.
+    from featurenet_tpu.ops.conv_dw import conv_dw_folded, dw_folded_supported
+
+    for name, B, R, Cin, Cout, K in [
+        ("conv2_dw_b128_k5", 128, 32, 32, 32, 5),
+        ("conv3_dw_b128_k3", 128, 16, 32, 64, 3),
+    ]:
+        x = jnp.asarray(rng.standard_normal((B, R, R, R, Cin)), jnp.bfloat16)
+        g = jnp.asarray(rng.standard_normal((B, R, R, R, Cout)), jnp.bfloat16)
+        w0 = jnp.zeros((K, K, K, Cin, Cout), jnp.float32)
+
+        def xla_dw(x, g):
+            _, vjp = jax.vjp(
+                lambda w: jax.lax.conv_general_dilated(
+                    x, w.astype(x.dtype), (1, 1, 1), "SAME",
+                    dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                ),
+                w0,
+            )
+            return vjp(g)[0]
+
+        flops = 2 * B * R ** 3 * K ** 3 * Cin * Cout
+        t_xla = scan_time(xla_dw, x, g, iters=16)
+        row = {"metric": f"{name}_xla", "value": round(t_xla * 1e3, 3),
+               "unit": "ms", "tflops": round(flops / t_xla / 1e12, 1)}
+        if dw_folded_supported(x.shape, K, Cout, x.dtype):
+            t_fold = scan_time(
+                lambda x, g: conv_dw_folded(x, g, K), x, g, iters=16
+            )
+            row["folded_ms"] = round(t_fold * 1e3, 3)
+            row["folded_tflops"] = round(flops / t_fold / 1e12, 1)
+            row["folded_vs_xla"] = round(t_xla / t_fold, 2)
+        print(json.dumps(row))
+
 
 if __name__ == "__main__":
     main()

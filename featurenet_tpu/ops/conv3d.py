@@ -245,6 +245,75 @@ def _vjp_bwd(res, g):
 conv3d_p.defvjp(_vjp_fwd, _vjp_bwd)
 
 
+def _xla_conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (1, 1, 1), "SAME",
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+
+
+@jax.custom_vjp
+def conv3d_hybrid(x, w):
+    """Stride-1 SAME conv: XLA forward/input-grad, Pallas weight-grad.
+
+    XLA's forward and input-grad lowerings already run near the shape
+    ceiling (BASELINE.md microbench); the weight grad is the piece XLA
+    leaves 4x on the table for narrow Cout (25 % MXU columns at Cout=32),
+    and ``ops.conv_dw.conv_dw_folded`` reshapes exactly that contraction
+    onto full MXU tiles. Everything else matches ``lax.conv`` bitwise.
+    """
+    return _xla_conv(x, w)
+
+
+def _hybrid_fwd(x, w):
+    return _xla_conv(x, w), (x, w)
+
+
+def _hybrid_bwd(res, g):
+    from featurenet_tpu.ops.conv_dw import conv_dw_folded
+
+    x, w = res
+    k = w.shape[0]
+    # dx: transpose conv = conv of the cotangent with the spatially-flipped,
+    # channel-transposed kernel (stride-1 SAME odd-K) — XLA's own lowering.
+    w_flip = jnp.flip(w, axis=(0, 1, 2)).swapaxes(3, 4)
+    dx = _xla_conv(g, w_flip)
+    dw = conv_dw_folded(x, g, k).astype(w.dtype)
+    return dx, dw
+
+
+conv3d_hybrid.defvjp(_hybrid_fwd, _hybrid_bwd)
+
+
+class HybridConv(nn.Module):
+    """Stride-1 SAME conv block backed by ``conv3d_hybrid`` (no bias).
+
+    Same parameter shape/init as ``nn.Conv``; activations stay in ``dtype``
+    (bf16 on TPU — the folded dW kernel accumulates fp32 like XLA does).
+    Shapes the dW VMEM plan can't hold fall back to the plain XLA conv.
+    """
+
+    features: int
+    kernel_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from featurenet_tpu.ops.conv_dw import dw_folded_supported
+
+        k, cin = self.kernel_size, x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(batch_axis=(), in_axis=(0, 1, 2, 3)),
+            (k, k, k, cin, self.features),
+            jnp.float32,
+        )
+        xc = x.astype(self.dtype)
+        if dw_folded_supported(xc.shape, k, self.features, xc.dtype):
+            return conv3d_hybrid(xc, kernel)
+        return _xla_conv(xc, kernel)
+
+
 class PallasConv(nn.Module):
     """Stride-1 SAME conv block backed by ``conv3d_p`` (no bias).
 

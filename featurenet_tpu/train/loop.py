@@ -131,6 +131,7 @@ class Trainer:
                 self.model, cfg.task, cfg.label_smoothing,
                 augment_groups=cfg.augment_groups if self._device_aug else 0,
                 packed=packed,
+                seg_loss=cfg.seg_loss,
             ),
             in_shardings=(self.state_sh, self.batch_sh, rep),
             out_shardings=(self.state_sh, rep),
@@ -256,11 +257,17 @@ class Trainer:
 
     def evaluate(self) -> dict[str, float]:
         if hasattr(self.eval_data, "epoch_batches"):
-            # Cache-backed: one exact pass over the held-out split. (Multi-
-            # host note: every host walks the same epoch, so global batches
-            # repeat each sample process_count times — accuracy is still
-            # exact, just redundantly computed; fine at this dataset scale.)
-            batches = self.eval_data.epoch_batches(self.eval_data.local_batch)
+            # Cache-backed: one exact pass over the held-out split, sharded
+            # across hosts — host i feeds the i-th decimation of the split
+            # into its slice of the global batch, so the globally-reduced
+            # masked sums count every sample exactly once and eval wall
+            # time scales 1/process_count (round 1 walked the full epoch on
+            # every host, process_count-times redundant).
+            batches = self.eval_data.epoch_batches(
+                self.eval_data.local_batch,
+                num_shards=jax.process_count(),
+                shard_id=jax.process_index(),
+            )
         else:
             it = iter(self.eval_data)
             batches = (next(it) for _ in range(self.cfg.eval_batches))

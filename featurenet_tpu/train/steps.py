@@ -57,14 +57,40 @@ def classification_loss(
     return loss, {"loss": loss, "accuracy": acc}
 
 
+def _soft_dice(logits: jax.Array, seg: jax.Array) -> jax.Array:
+    """Mean soft-Dice loss over the classes present in the batch.
+
+    Dice optimizes the eval metric (IoU) directly where cross-entropy
+    optimizes per-voxel calibration: CE's gradient on a thin feature shell
+    is dominated by the easy background interior, while Dice normalizes per
+    class, so small features keep full-strength gradients. Background is
+    included as a class (its Dice term penalizes false feature voxels).
+    """
+    n_cls = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    true_1h = jax.nn.one_hot(seg, n_cls, dtype=probs.dtype)
+    axes = tuple(range(probs.ndim - 1))
+    inter = (probs * true_1h).sum(axes)
+    denom = probs.sum(axes) + true_1h.sum(axes)
+    present = true_1h.sum(axes) > 0
+    dice = 1.0 - (2.0 * inter + 1.0) / (denom + 1.0)
+    return (dice * present).sum() / jnp.maximum(present.sum(), 1)
+
+
 def segmentation_loss(
     logits: jax.Array,  # [B, D, H, W, C+1] fp32
     seg: jax.Array,  # [B, D, H, W] int32, 0 = background
+    variant: str = "balanced_ce",
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
-    """Per-voxel cross-entropy with background down-weighting.
+    """Per-voxel loss; ``variant`` picks the class-imbalance treatment.
 
-    Background dominates (a carved part is mostly stock/air), so feature
-    voxels are up-weighted to balance the gradient signal.
+    - ``balanced_ce``: cross-entropy with background down-weighting —
+      background dominates (a carved part is mostly stock/air), so feature
+      voxels are up-weighted until fg and bg contribute ~equally.
+    - ``ce_dice``: balanced CE + soft Dice (``_soft_dice``) — the round-2
+      push past the 0.779-IoU plateau; Dice optimizes the IoU metric
+      directly per class.
+    - ``dice``: soft Dice alone (ablation arm).
     """
     per_voxel = optax.softmax_cross_entropy_with_integer_labels(logits, seg)
     is_fg = (seg > 0).astype(jnp.float32)
@@ -72,7 +98,15 @@ def segmentation_loss(
     fg_frac = is_fg.mean()
     w = jnp.where(seg > 0, 0.5 / jnp.maximum(fg_frac, 1e-4),
                   0.5 / jnp.maximum(1.0 - fg_frac, 1e-4))
-    loss = (per_voxel * w).mean()
+    ce = (per_voxel * w).mean()
+    if variant == "balanced_ce":
+        loss = ce
+    elif variant == "ce_dice":
+        loss = ce + _soft_dice(logits, seg)
+    elif variant == "dice":
+        loss = _soft_dice(logits, seg)
+    else:
+        raise ValueError(f"unknown segmentation loss variant {variant!r}")
     pred = jnp.argmax(logits, axis=-1)
     acc = (pred == seg).mean()
     fg_acc = jnp.where(
@@ -87,6 +121,7 @@ def make_train_step(
     label_smoothing: float = 0.0,
     augment_groups: int = 0,
     packed: bool = False,
+    seg_loss: str = "balanced_ce",
 ) -> Callable:
     """Build the pure train-step function (jit it with shardings at call site).
 
@@ -113,7 +148,9 @@ def make_train_step(
         if task == "classify":
             loss, metrics = classification_loss(out, target, label_smoothing)
         else:
-            loss, metrics = segmentation_loss(out, target.astype(jnp.int32))
+            loss, metrics = segmentation_loss(
+                out, target.astype(jnp.int32), variant=seg_loss
+            )
         return loss, (mutated["batch_stats"], metrics)
 
     def train_step(state: TrainState, batch, rng):
@@ -236,6 +273,12 @@ def aggregate_eval(metric_list: list[dict]) -> dict[str, float]:
         present = union > 0  # ignore classes absent from both pred & truth
         iou = np.where(present, total["intersection"] / np.maximum(union, 1), 0.0)
         out["mean_iou"] = float(iou.sum() / np.maximum(present.sum(), 1))
+        # Per-class IoU (index 0 = background) so the summary shows *which*
+        # feature classes drag the mean, not just that something does.
+        out["per_class_iou"] = [
+            round(float(v), 4) if p else None
+            for v, p in zip(iou, present)
+        ]
     return out
 
 

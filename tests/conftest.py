@@ -9,6 +9,11 @@ exchange, BN sync) runs in CI with no TPU attached.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Silence XLA:CPU AOT-loader noise from the persistent compilation cache
+# below: it logs a benign "machine feature +prefer-no-scatter … SIGILL"
+# error-level line per cache hit (compiler preference pseudo-features the
+# host probe doesn't list; same physical machine, results verified equal).
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +27,15 @@ import jax  # noqa: E402
 # initialization does.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: this suite is compile-dominated (the model
+# zoo × train/eval/init graphs), and the graphs are identical run to run —
+# caching them makes the reflexive `pytest tests/` fast after the first run
+# while changing nothing about what executes. Lives under the gitignored
+# .cache/ next to the dataset caches.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".cache", "jax_compile")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -29,3 +43,32 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# --- slow tier ---------------------------------------------------------------
+# Default `pytest tests/` is the reflexive tier (target < ~3 min on this
+# single-core box); tests marked @pytest.mark.slow only run with --slow.
+# Keep the default tier the one that exercises every subsystem — slow means
+# "long-running variant/e2e whose coverage is duplicated in miniature by a
+# fast test", never "the only test of X".
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--slow", action="store_true", default=False,
+        help="also run tests marked slow (the full tier)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test; excluded unless --slow is given"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--slow"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: re-run with --slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
 
 import numpy as np
 import pytest
@@ -58,13 +57,13 @@ def test_check_identity_passes_on_equal_and_raises_on_mismatch():
         )
 
 
-def _train_briefly(tmp_path, **over):
+def _train_briefly(ckpt_dir, **over):
     from featurenet_tpu.train.loop import Trainer
 
     cfg = get_config(
         "smoke16",
         total_steps=2,
-        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_dir=str(ckpt_dir),
         checkpoint_every=2,
         eval_every=10**9,
         log_every=10**9,
@@ -76,18 +75,26 @@ def _train_briefly(tmp_path, **over):
     return cfg
 
 
-def test_sidecar_written_and_predictor_self_configures(tmp_path):
+@pytest.fixture(scope="module")
+def trained_ckpt(tmp_path_factory):
+    """One 2-step smoke16 run with a checkpoint+sidecar, shared by every
+    read-only consumer in this module (training it per-test dominated the
+    suite's wall time)."""
+    d = tmp_path_factory.mktemp("persist") / "ckpt"
+    cfg = _train_briefly(d)
+    return cfg, str(d)
+
+
+def test_sidecar_written_and_predictor_self_configures(trained_ckpt):
     from featurenet_tpu.infer import Predictor
     from featurenet_tpu.train.checkpoint import load_run_config
 
-    cfg = _train_briefly(tmp_path)
-    path = tmp_path / "ckpt" / "config.json"
-    assert path.exists()
-    loaded = load_run_config(str(tmp_path / "ckpt"))
+    cfg, ckpt = trained_ckpt
+    loaded = load_run_config(ckpt)
     assert loaded == cfg
 
     # No flags, no guessing: the Predictor reads the sidecar.
-    p = Predictor.from_checkpoint(str(tmp_path / "ckpt"), batch=2)
+    p = Predictor.from_checkpoint(ckpt, batch=2)
     assert p.cfg.resolution == 16
     assert p.cfg.name == "smoke16"
     grids = np.zeros((1, 16, 16, 16), np.float32)
@@ -96,21 +103,20 @@ def test_sidecar_written_and_predictor_self_configures(tmp_path):
     assert probs.shape[1] == p.cfg.arch.num_classes
 
 
-def test_predictor_rejects_contradicting_explicit_config(tmp_path):
+def test_predictor_rejects_contradicting_explicit_config(trained_ckpt):
     from featurenet_tpu.infer import Predictor
 
-    _train_briefly(tmp_path)
+    _, ckpt = trained_ckpt
     with pytest.raises(ValueError, match="contradict"):
-        Predictor.from_checkpoint(
-            str(tmp_path / "ckpt"), config=get_config("pod64"), batch=2
-        )
+        Predictor.from_checkpoint(ckpt, config=get_config("pod64"), batch=2)
 
 
-def test_cli_eval_uses_sidecar_and_rejects_mismatched_flags(tmp_path, capsys):
+def test_cli_eval_uses_sidecar_and_rejects_mismatched_flags(
+    trained_ckpt, capsys
+):
     from featurenet_tpu import cli
 
-    _train_briefly(tmp_path)
-    ckpt = str(tmp_path / "ckpt")
+    _, ckpt = trained_ckpt
     # No --config at all: the sidecar supplies smoke16 (default used to be
     # pod64 — this is the "self-configuring" acceptance case).
     cli.main(["eval", "--checkpoint-dir", ckpt, "--data-workers", "1"])
@@ -129,10 +135,12 @@ def test_cli_eval_uses_sidecar_and_rejects_mismatched_flags(tmp_path, capsys):
 
 
 def test_cli_train_resume_reads_sidecar(tmp_path, capsys):
-    """Resume without flags continues the persisted config, not pod64."""
+    """Resume without flags continues the persisted config, not pod64.
+    (Own checkpoint dir — resuming advances the step and rewrites the
+    sidecar, which would corrupt the shared fixture.)"""
     from featurenet_tpu import cli
 
-    _train_briefly(tmp_path)
+    _train_briefly(tmp_path / "ckpt")
     capsys.readouterr()  # drain the setup run's own log lines
     ckpt = str(tmp_path / "ckpt")
     cli.main([
@@ -146,9 +154,15 @@ def test_cli_train_resume_reads_sidecar(tmp_path, capsys):
 
 
 def test_sidecar_scrubs_ephemeral_fields(tmp_path):
+    """No training needed: _cfg_from_checkpoint is pure config surgery."""
     from featurenet_tpu.cli import _cfg_from_checkpoint
 
-    cfg = _train_briefly(tmp_path, heartbeat_file=str(tmp_path / "hb"))
+    cfg = get_config(
+        "smoke16",
+        heartbeat_file=str(tmp_path / "hb"),
+        tb_dir=str(tmp_path / "tb"),
+        profile_dir=str(tmp_path / "prof"),
+    )
 
     class _Args:
         pass
@@ -157,3 +171,16 @@ def test_sidecar_scrubs_ephemeral_fields(tmp_path):
     assert got.heartbeat_file is None
     assert got.tb_dir is None
     assert got.profile_dir is None
+
+
+def test_conv_backend_is_not_identity(trained_ckpt):
+    """conv_backend selects a lowering, not a model: A/B-ing backends on
+    one trained checkpoint must be allowed (every backend shares the same
+    param tree)."""
+    cfg, _ = trained_ckpt
+    check_identity(
+        cfg,
+        dataclasses.replace(
+            cfg, arch=dataclasses.replace(cfg.arch, conv_backend="hybrid_dw")
+        ),
+    )  # no raise

@@ -91,6 +91,10 @@ def test_segmentation_inference_end_to_end(tmp_path, rng):
         log_every=8,
         checkpoint_dir=str(tmp_path / "ckpt"),
         data_workers=1,
+        # Narrow decoder: this test is about the inference plumbing, not
+        # segmentation quality — full-width U-Net compiles dominated the
+        # suite (round-1: 102 s for this test alone).
+        seg_features=(8, 16),
     )
     Trainer(cfg).run()
     pred = Predictor.from_checkpoint(str(tmp_path / "ckpt"), cfg, batch=2)

@@ -285,3 +285,26 @@ def test_legacy_cache_in_canonical_order_still_loads(tmp_path):
     ds = VoxelCacheDataset(out, global_batch=4, split="train")
     from featurenet_tpu.data.synthetic import CLASS_NAMES
     assert ds.labels.max() == len(CLASS_NAMES) - 1
+
+
+def test_sharded_epoch_batches_partition_exactly(tmp_path):
+    """Multi-host eval sharding: the union of all shards' masked samples is
+    the full split, each sample exactly once, and every shard emits the
+    same number of batches (hosts dispatch the eval step in lockstep)."""
+    out = str(tmp_path / "cache")
+    export_synthetic_cache(out, per_class=3, resolution=16)
+    ds = VoxelCacheDataset(out, global_batch=4, split="test")
+    full = []
+    for b in ds.epoch_batches(4):
+        full.extend(b["label"][b["mask"] > 0].tolist())
+    for shards in (2, 3):
+        seen = []
+        counts = []
+        for sid in range(shards):
+            n = 0
+            for b in ds.epoch_batches(4, num_shards=shards, shard_id=sid):
+                seen.extend(b["label"][b["mask"] > 0].tolist())
+                n += 1
+            counts.append(n)
+        assert len(set(counts)) == 1, counts  # lockstep
+        assert sorted(seen) == sorted(full)
