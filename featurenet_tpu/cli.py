@@ -137,7 +137,13 @@ def _cfg_from_checkpoint(saved, args):
     # resume meant unless the flag was passed again.
     for k in ("heartbeat_file", "profile_dir", "tb_dir"):
         over.setdefault(k, None)
-    return dataclasses.replace(saved, **over).validate()
+    # Arch flags must reach the returned config too — check_identity above
+    # already rejected real contradictions, so what flows through here is
+    # exactly the deliberately-allowed lowering choice (conv_backend A/B
+    # on one trained run).
+    return _apply_arch_overrides(
+        dataclasses.replace(saved, **over).validate(), args
+    )
 
 
 def main(argv=None) -> None:

@@ -184,3 +184,18 @@ def test_conv_backend_is_not_identity(trained_ckpt):
             cfg, arch=dataclasses.replace(cfg.arch, conv_backend="hybrid_dw")
         ),
     )  # no raise
+
+
+def test_cli_conv_backend_override_reaches_config(trained_ckpt):
+    """--conv-backend on a sidecar checkpoint must flow into the returned
+    config (it passed the identity check, so dropping it silently would
+    make backend A/B runs measure the same lowering twice)."""
+    from featurenet_tpu.cli import _cfg_from_checkpoint
+
+    cfg, _ = trained_ckpt
+
+    class _Args:
+        conv_backend = "hybrid_dw"
+
+    got = _cfg_from_checkpoint(cfg, _Args())
+    assert got.arch.conv_backend == "hybrid_dw"

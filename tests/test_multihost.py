@@ -25,8 +25,9 @@ import sys
 
 _WORKER = r"""
 import json, sys
-pid, nproc, port, steps = (
-    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
+pid, nproc, port, steps, cache = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+    sys.argv[5],
 )
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -51,6 +52,7 @@ cfg = get_config(
     eval_every=10**9,
     checkpoint_every=10**9,
     eval_batches=1,
+    data_cache=cache or None,
 )
 trainer = Trainer(cfg)
 last = trainer.run()
@@ -58,6 +60,17 @@ print("FINAL " + json.dumps(
     {k: float(v) for k, v in last.items()
      if isinstance(v, (int, float)) and not isinstance(v, bool)}
 ))
+if cache:
+    # Host-sharded exact eval: each host walks its decimation of the
+    # held-out split; global sums must agree bitwise AND count every
+    # sample exactly once (the confusion total is the proof).
+    import numpy as np
+    ev = trainer.evaluate()
+    print("EVAL " + json.dumps({
+        "accuracy": ev["accuracy"],
+        "loss": ev["loss"],
+        "n_evaluated": int(np.asarray(ev["confusion"]).sum()),
+    }))
 """
 
 
@@ -67,7 +80,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(port: int, steps: int, nproc: int) -> list[str]:
+def _run_workers(
+    port: int, steps: int, nproc: int, cache: str = ""
+) -> list[str]:
     """Spawn, concurrently drain, and always reap the worker processes.
 
     Concurrent draining matters: a worker that fills its unread stdout pipe
@@ -88,7 +103,7 @@ def _run_workers(port: int, steps: int, nproc: int) -> list[str]:
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, str(i), str(nproc), str(port),
-             str(steps)],
+             str(steps), cache],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -128,23 +143,35 @@ def _run_workers(port: int, steps: int, nproc: int) -> list[str]:
     return outs
 
 
-def test_two_process_training_stays_in_sync():
+def test_two_process_training_stays_in_sync(tmp_path):
+    from featurenet_tpu.data.offline import (
+        VoxelCacheDataset,
+        export_synthetic_cache,
+    )
+
+    cache = str(tmp_path / "cache")
+    export_synthetic_cache(cache, per_class=2, resolution=16)
+    held_out = len(VoxelCacheDataset(cache, global_batch=8, split="test"))
+
     steps, nproc = 3, 2
     outs = []
     # The free-port probe races with the coordinator's bind (TOCTOU);
     # retry once on a fresh port if the rendezvous itself failed to bind.
     for attempt in range(2):
-        outs = _run_workers(_free_port(), steps, nproc)
+        outs = _run_workers(_free_port(), steps, nproc, cache=cache)
         if not any("ddress already in use" in o for o in outs):
             break
     for i, out in enumerate(outs):
         assert "FINAL " in out, f"worker {i} failed:\n{out}"
 
-    finals = []
+    finals, evals = [], []
     for out in outs:
         lines = [l for l in out.splitlines() if l.startswith("FINAL ")]
         assert lines, out
         finals.append(json.loads(lines[-1][len("FINAL "):]))
+        ev_lines = [l for l in out.splitlines() if l.startswith("EVAL ")]
+        assert ev_lines, out
+        evals.append(json.loads(ev_lines[-1][len("EVAL "):]))
     # Global metrics must agree across hosts bitwise: each host ran the
     # same compiled step over the same global (sharded) batch.
     assert finals[0].keys() == finals[1].keys()
@@ -155,3 +182,8 @@ def test_two_process_training_stays_in_sync():
     # And training actually happened: the final loss is a finite number
     # produced by `steps` real optimizer updates.
     assert finals[0]["loss"] > 0.0
+    # Host-sharded exact eval: bitwise-identical global results on every
+    # host, and the confusion total proves each held-out sample was
+    # counted exactly once (the round-1 path counted them nproc times).
+    assert evals[0] == evals[1], evals
+    assert evals[0]["n_evaluated"] == held_out, (evals, held_out)
