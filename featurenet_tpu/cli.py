@@ -49,6 +49,11 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="backend for stride-1 conv blocks (default xla)")
     p.add_argument("--seg-loss", choices=["balanced_ce", "ce_dice", "dice"],
                    help="segmentation loss variant (default balanced_ce)")
+    p.add_argument("--restart-every", type=int, dest="restart_every_steps",
+                   help="supervised runs: checkpoint + respawn a fresh "
+                        "process every N steps (clears the tunnel client's "
+                        "host-RSS leak; does not consume the restart "
+                        "budget)")
     p.add_argument("--debug-nans", action="store_true",
                    help="jax_debug_nans: fail fast on the op producing a NaN")
 
@@ -76,6 +81,7 @@ def _overrides(args) -> dict:
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
+        "restart_every_steps",
     ]
     out = {
         k: getattr(args, k, None)
@@ -134,8 +140,13 @@ def _cfg_from_checkpoint(saved, args):
     over.pop("resolution", None)  # identity — already verified equal
     # Ephemeral run-environment fields must not leak across runs: a stale
     # heartbeat path or the training run's TB dir is never what an eval or
-    # resume meant unless the flag was passed again.
-    for k in ("heartbeat_file", "profile_dir", "tb_dir"):
+    # resume meant unless the flag was passed again. restart_every_steps is
+    # in the list because only a *supervised* run should segment (the
+    # supervisor's child argv re-passes --restart-every every spawn); an
+    # unsupervised resume inheriting it from the sidecar would die with
+    # exit 75 mid-run and nothing would respawn it.
+    for k in ("heartbeat_file", "profile_dir", "tb_dir",
+              "restart_every_steps"):
         over.setdefault(k, None)
     # Arch flags must reach the returned config too — check_identity above
     # already rejected real contradictions, so what flows through here is

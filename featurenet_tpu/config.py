@@ -73,6 +73,14 @@ class Config:
     # 128³-grids-outgrow-HBM path. Needs mesh_model > 1 to have any effect.
     spatial: bool = False
 
+    # Planned periodic restart (supervised runs): exit cleanly-for-restart
+    # every N steps after checkpointing; the supervisor (train.supervisor)
+    # respawns without charging the restart budget. Motivation: this
+    # environment's tunneled-TPU client leaks host RSS roughly linearly
+    # with steps and throughput decays with it (BASELINE.md seg64 notes)
+    # — a fresh process restores full speed and the Orbax resume makes the
+    # handoff exact.
+    restart_every_steps: Optional[int] = None
     # Backpressure: max train steps dispatched ahead of confirmed execution.
     # Async dispatch with no bound pins every in-flight batch in memory; on
     # backends where block_until_ready is unreliable (this environment's
@@ -106,6 +114,19 @@ class Config:
             raise ValueError(f"unknown task {self.task!r}")
         if self.seg_loss not in ("balanced_ce", "ce_dice", "dice"):
             raise ValueError(f"unknown seg_loss {self.seg_loss!r}")
+        if self.restart_every_steps is not None:
+            if self.restart_every_steps <= 0:
+                raise ValueError(
+                    f"restart_every_steps must be positive, got "
+                    f"{self.restart_every_steps}"
+                )
+            if not self.checkpoint_dir:
+                raise ValueError(
+                    "restart_every_steps requires checkpoint_dir: a "
+                    "segmented run resumes from its checkpoint, and "
+                    "silently ignoring the flag would leave the RSS-leak "
+                    "mitigation off"
+                )
         if self.augment and self.augment_device and self.augment_groups < 1:
             raise ValueError(
                 "augment_groups must be >= 1 when device augmentation is "

@@ -293,6 +293,13 @@ class Trainer:
         cfg = self.cfg
         total = num_steps if num_steps is not None else cfg.total_steps
         start = self.resume_if_available()
+        # Planned-restart segmenting (supervised runs): stop early, save,
+        # and exit RESTART_EXIT_CODE so the supervisor respawns a fresh
+        # process (this environment's tunnel client leaks host RSS with
+        # steps; a new process restores full throughput — see Config).
+        stop = total
+        if cfg.restart_every_steps and self.ckpt is not None:
+            stop = min(total, start + cfg.restart_every_steps)
         self.logger.log(start, {"params": self.params_n,
                                 "devices": len(self.mesh.devices.flat)},
                         prefix="setup")
@@ -312,7 +319,7 @@ class Trainer:
         # most K steps (and their pinned host batches) are ever in flight.
         pending: collections.deque = collections.deque()
         try:
-            for step in range(start, total):
+            for step in range(start, stop):
                 if step == trace_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                     trace_active = True
@@ -362,6 +369,26 @@ class Trainer:
             self.logger.flush()
         if self.ckpt:
             self.ckpt.wait()
+        if stop < total:
+            # Segment finished but the run hasn't: persist exactly-here
+            # state (the periodic save may not align with the cut) and ask
+            # the supervisor for a fresh process.
+            from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE
+
+            if self.ckpt.latest_step() != int(self.state.step):
+                self.ckpt.save(self.state)
+                self.ckpt.wait()
+            # A completed save is confirmed progress: without this beat, a
+            # short segment (< max_inflight/eval/checkpoint cadence) would
+            # exit 75 having never beaten, and the supervisor would
+            # misclassify the planned restart as a startup failure.
+            self._heartbeat()
+            self.logger.log(
+                int(self.state.step),
+                {"planned_restart_exit": 1.0},
+                prefix="setup",
+            )
+            raise SystemExit(RESTART_EXIT_CODE)
         return last
 
 

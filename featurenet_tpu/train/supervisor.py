@@ -42,11 +42,19 @@ def touch_heartbeat(path: str) -> None:
         os.utime(path, None)
 
 
+# Child exit code meaning "checkpointed and asking to be respawned" (the
+# planned-restart protocol, Config.restart_every_steps). Chosen as BSD's
+# EX_TEMPFAIL: distinct from 0 (done) and from crash codes, so an
+# unsupervised run exiting this way is visibly "not finished".
+RESTART_EXIT_CODE = 75
+
+
 @dataclasses.dataclass
 class SuperviseResult:
     exit_code: int  # final child exit code (0 = success)
     restarts: int  # how many times the child was restarted
     stalls: int  # how many restarts were due to a stale heartbeat
+    planned: int = 0  # planned (restart_every_steps) respawns, not counted
 
 
 def _kill_tree(proc: subprocess.Popen) -> None:
@@ -91,7 +99,7 @@ def supervise(
     """
     grace = grace_s if grace_s is not None else max(stall_timeout_s, 600.0)
 
-    restarts = stalls = 0
+    restarts = stalls = planned = 0
     # Consecutive nonzero exits before any heartbeat: a child that dies
     # during startup (argparse error, missing cache dir, out-of-range label)
     # is deterministic — retrying it max_restarts times pays full JAX/device
@@ -154,8 +162,21 @@ def supervise(
                 pass
         if not stalled and rc == 0:
             log(json.dumps({"supervisor": "done", "restarts": restarts,
-                            "stalls": stalls}))
-            return SuperviseResult(0, restarts, stalls)
+                            "stalls": stalls, "planned": planned}))
+            return SuperviseResult(0, restarts, stalls, planned)
+        if not stalled and rc == RESTART_EXIT_CODE and first_beat_seen:
+            # Planned restart: the child checkpointed and asked for a fresh
+            # process (restart_every_steps). Free, by design — it must not
+            # consume the failure budget, or long runs would trade away
+            # their real crash protection. A completed segment is real
+            # progress, so it also clears the consecutive-startup-failure
+            # counter (two *non-consecutive* transients must not read as a
+            # deterministic startup failure).
+            planned += 1
+            early_fails = 0
+            log(json.dumps({"supervisor": "planned_restart",
+                            "count": planned}))
+            continue
         if not stalled and not first_beat_seen:
             early_fails += 1
             if early_fails >= 2:
@@ -165,7 +186,8 @@ def supervise(
                               "deterministic startup failure",
                     "restarts": restarts, "stalls": stalls,
                 }))
-                return SuperviseResult(rc if rc else 1, restarts, stalls)
+                return SuperviseResult(rc if rc else 1, restarts, stalls,
+                                       planned)
         else:
             early_fails = 0
         stalls += int(stalled)
@@ -173,7 +195,8 @@ def supervise(
         if restarts > max_restarts:
             log(json.dumps({"supervisor": "giving_up", "restarts": restarts - 1,
                             "stalls": stalls, "last_exit": rc}))
-            return SuperviseResult(rc if rc else 1, restarts - 1, stalls)
+            return SuperviseResult(rc if rc else 1, restarts - 1, stalls,
+                                   planned)
         log(json.dumps({"supervisor": "restart", "attempt": restarts + 1,
                         "reason": "stall" if stalled else f"exit_{rc}"}))
 

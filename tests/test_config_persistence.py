@@ -199,3 +199,23 @@ def test_cli_conv_backend_override_reaches_config(trained_ckpt):
 
     got = _cfg_from_checkpoint(cfg, _Args())
     assert got.arch.conv_backend == "hybrid_dw"
+
+
+def test_restart_every_steps_validation_and_sidecar_scrub(tmp_path):
+    """restart_every_steps: rejected when non-positive or checkpoint-less;
+    scrubbed on sidecar resume (only the supervisor re-passes the flag)."""
+    from featurenet_tpu.cli import _cfg_from_checkpoint
+
+    with pytest.raises(ValueError, match="positive"):
+        get_config("smoke16", restart_every_steps=-5,
+                   checkpoint_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        get_config("smoke16", restart_every_steps=100)
+
+    cfg = get_config("smoke16", restart_every_steps=100,
+                     checkpoint_dir=str(tmp_path))
+
+    class _Args:
+        pass
+
+    assert _cfg_from_checkpoint(cfg, _Args()).restart_every_steps is None

@@ -218,3 +218,50 @@ def test_deleted_heartbeat_file_is_recreated_not_fatal(tmp_path):
     t.join()
     assert res.exit_code == 0
     assert res.restarts == 0
+
+
+def test_planned_restart_exit_code_is_free(tmp_path):
+    """A child exiting RESTART_EXIT_CODE after beating is respawned without
+    consuming the restart budget; one that never beat is a failure."""
+    from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE
+
+    attempts = tmp_path / "attempts"
+    hb = tmp_path / "hb"
+    code = (
+        "import os, sys, time\n"
+        f"a={str(attempts)!r}; hb={str(hb)!r}\n"
+        "n = len(open(a).read()) if os.path.exists(a) else 0\n"
+        "open(a, 'a').write('x')\n"
+        "time.sleep(0.3); os.utime(hb, None)  # beat\n"
+        f"sys.exit(0 if n >= 3 else {RESTART_EXIT_CODE})\n"
+    )
+    res = supervise(
+        _child(code),
+        stall_timeout_s=10,
+        max_restarts=0,  # planned respawns must not need any budget
+        heartbeat_file=str(hb),
+        poll_s=0.05,
+        log=lambda _: None,
+    )
+    assert res.exit_code == 0
+    assert res.restarts == 0
+    assert res.planned == 3
+    assert attempts.read_text() == "xxxx"
+
+
+def test_planned_exit_before_first_beat_is_a_failure(tmp_path):
+    """RESTART_EXIT_CODE without a heartbeat means the child never made
+    progress — treating it as free would loop forever."""
+    from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE
+
+    res = supervise(
+        _child(f"import sys; sys.exit({RESTART_EXIT_CODE})"),
+        stall_timeout_s=5,
+        max_restarts=5,
+        heartbeat_file=str(tmp_path / "hb"),
+        poll_s=0.05,
+        log=lambda _: None,
+    )
+    assert res.exit_code == RESTART_EXIT_CODE
+    assert res.planned == 0
+    assert res.restarts == 1  # two startup failures -> permanent

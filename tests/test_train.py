@@ -148,3 +148,39 @@ def test_segmentation_loss_variants():
         assert np.isfinite(np.asarray(g)).all()
     with pytest.raises(ValueError, match="variant"):
         segmentation_loss(perfect, seg, variant="nope")
+
+
+def test_trainer_planned_restart_segments(tmp_path):
+    """restart_every_steps: the run stops at the segment boundary with a
+    checkpoint exactly there and SystemExit(RESTART_EXIT_CODE); resuming
+    continues to completion."""
+    import pytest
+
+    from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE
+
+    cfg = get_config(
+        "smoke16",
+        total_steps=5,
+        restart_every_steps=2,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=10**9,
+        eval_every=10**9,
+        log_every=10**9,
+        data_workers=1,
+        global_batch=8,
+    )
+    t = Trainer(cfg)
+    with pytest.raises(SystemExit) as e:
+        t.run()
+    assert e.value.code == RESTART_EXIT_CODE
+    assert t.ckpt.latest_step() == 2
+
+    t2 = Trainer(cfg)
+    with pytest.raises(SystemExit):
+        t2.run()  # 2 -> 4
+    assert t2.ckpt.latest_step() == 4
+
+    t3 = Trainer(cfg)
+    last = t3.run()  # 4 -> 5: finishes, no exit
+    assert int(t3.state.step) == 5
+    assert "loss" in last
