@@ -36,6 +36,15 @@ def measure_train_step(
     """
     import jax
 
+    if cfg.task != "classify":
+        # This path builds a FeatureNet classifier on the classify wire
+        # format unconditionally; benchmarking a segment config here would
+        # silently measure the wrong model under that config's name.
+        raise ValueError(
+            f"measure_train_step benchmarks classify configs only; "
+            f"{cfg.name!r} has task={cfg.task!r}"
+        )
+
     from featurenet_tpu.data.synthetic import (
         WIRE_KEYS,
         generate_batch,

@@ -74,6 +74,11 @@ def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
                         "(default 600)")
     p.add_argument("--max-restarts", type=int, default=5,
                    help="restarts allowed before the supervisor gives up")
+    # Internal: set by supervisor.child_argv_from_cli on the respawned child
+    # so the --restart-every-requires-a-supervisor guard lets the re-passed
+    # flag through (the child's respawner is the supervisor itself).
+    p.add_argument("--supervised-child", action="store_true",
+                   help=argparse.SUPPRESS)
 
 
 def _overrides(args) -> dict:
@@ -220,6 +225,22 @@ def main(argv=None) -> None:
                             "per-voxel label grid to this directory as "
                             "<stem>_seg.npz")
     args = parser.parse_args(argv)
+
+    if (
+        args.cmd == "train"
+        and getattr(args, "restart_every_steps", None)
+        and not getattr(args, "supervise", False)
+        and not getattr(args, "supervised_child", False)
+    ):
+        # Without a supervisor, the child checkpoints and exits 75 at the
+        # first segment boundary and nothing respawns it — the run silently
+        # stops mid-training. Refuse at parse time (ADVICE r2; the sidecar
+        # path already strips restart_every_steps on unsupervised resume).
+        raise SystemExit(
+            "--restart-every requires --supervise: a segmented run exits "
+            "(code 75) at every segment boundary and only the supervisor "
+            "respawns it — without one, training silently stops at step N"
+        )
 
     if args.cmd == "train" and getattr(args, "supervise", False):
         import os

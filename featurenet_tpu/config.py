@@ -359,9 +359,12 @@ def check_identity(saved: Config, requested: Config) -> None:
         if _identity_view(saved, f) != _identity_view(requested, f)
     ]
     if bad:
+        # Report the *identity view*, not the raw field: for `arch` the raw
+        # repr includes non-identity subfields (conv_backend) that may
+        # legitimately differ and would point the user at a non-mismatch.
         detail = "; ".join(
-            f"{f}: checkpoint={getattr(saved, f)!r} "
-            f"requested={getattr(requested, f)!r}"
+            f"{f}: checkpoint={_identity_view(saved, f)!r} "
+            f"requested={_identity_view(requested, f)!r}"
             for f in bad
         )
         raise ValueError(

@@ -221,4 +221,8 @@ def child_argv_from_cli(argv: Sequence[str], heartbeat_file: str) -> list[str]:
             continue
         out.append(a)
     out += ["--heartbeat-file", heartbeat_file]
+    # Mark the child as supervised: the CLI refuses a bare --restart-every
+    # (nothing would respawn the exit-75 child), but *this* child's respawner
+    # is us — the marker lets the re-passed --restart-every through.
+    out.append("--supervised-child")
     return out

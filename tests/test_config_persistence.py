@@ -219,3 +219,22 @@ def test_restart_every_steps_validation_and_sidecar_scrub(tmp_path):
         pass
 
     assert _cfg_from_checkpoint(cfg, _Args()).restart_every_steps is None
+
+
+def test_check_identity_detail_reports_identity_view_not_raw_repr():
+    """The mismatch message must diff the *identity view*: conv_backend is
+    deliberately non-identity (a lowering choice), so a repr that shows the
+    raw differing conv_backend would point the user at a non-mismatch."""
+    a = get_config("smoke16")
+    saved = dataclasses.replace(
+        a, arch=dataclasses.replace(a.arch, conv_backend="pallas")
+    )
+    requested = dataclasses.replace(
+        a, arch=dataclasses.replace(a.arch, stem_s2d=False)
+    )
+    with pytest.raises(ValueError) as ei:
+        check_identity(saved, requested)
+    # Both sides render through the neutralized view (conv_backend='xla');
+    # the real differing subfield (stem_s2d) is visible.
+    assert "pallas" not in str(ei.value)
+    assert "stem_s2d" in str(ei.value)

@@ -130,7 +130,7 @@ def test_child_argv_strips_supervision_flags():
     assert "--stall-timeout" not in tail
     assert "30" not in tail
     assert not any(a.startswith("--max-restarts") for a in tail)
-    assert tail[-2:] == ["--heartbeat-file", "/tmp/hb"]
+    assert tail[-3:] == ["--heartbeat-file", "/tmp/hb", "--supervised-child"]
     assert "--checkpoint-dir" in tail and "runs/x" in tail
 
 
@@ -265,3 +265,35 @@ def test_planned_exit_before_first_beat_is_a_failure(tmp_path):
     assert res.exit_code == RESTART_EXIT_CODE
     assert res.planned == 0
     assert res.restarts == 1  # two startup failures -> permanent
+
+
+def test_cli_refuses_restart_every_without_supervise(tmp_path):
+    """--restart-every on an unsupervised train dies with exit 75 at the
+    first segment boundary and nothing respawns it; the CLI refuses at
+    parse time instead (round-2 advice)."""
+    import pytest
+
+    from featurenet_tpu import cli
+
+    with pytest.raises(SystemExit, match="supervise"):
+        cli.main(["train", "--config", "smoke16", "--restart-every", "5",
+                  "--checkpoint-dir", str(tmp_path / "ck")])
+
+
+def test_supervised_child_passes_restart_every_guard(tmp_path):
+    """The supervisor's respawned child carries --restart-every with
+    --supervise stripped (child_argv_from_cli re-passes it each spawn) plus
+    the --supervised-child marker; the parse-time guard must let it through
+    — otherwise every supervised planned-restart run dies at startup.
+    Proof of passage: parsing proceeds far enough to reject the bogus
+    preset name (KeyError from get_config), i.e. past the guard."""
+    import pytest
+
+    from featurenet_tpu import cli
+
+    with pytest.raises(KeyError, match="no-such-preset"):
+        cli.main([
+            "train", "--config", "no-such-preset", "--restart-every", "5",
+            "--checkpoint-dir", str(tmp_path / "ck"), "--supervised-child",
+            "--heartbeat-file", str(tmp_path / "hb"),
+        ])

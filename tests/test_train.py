@@ -184,3 +184,16 @@ def test_trainer_planned_restart_segments(tmp_path):
     last = t3.run()  # 4 -> 5: finishes, no exit
     assert int(t3.state.step) == 5
     assert "loss" in last
+
+
+def test_measure_train_step_rejects_segment_config():
+    """benchmark.measure_train_step builds a classifier on the classify wire
+    format unconditionally — a segment config must be refused, not silently
+    benchmarked as the wrong model (round-2 advice)."""
+    import pytest
+
+    from featurenet_tpu.benchmark import measure_train_step
+    from featurenet_tpu.config import get_config
+
+    with pytest.raises(ValueError, match="classify"):
+        measure_train_step(get_config("seg64"))
