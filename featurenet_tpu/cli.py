@@ -198,10 +198,14 @@ def main(argv=None) -> None:
     p_stl.add_argument("--resolution", type=int, default=64)
     p_stl.add_argument("--seed", type=int, default=0)
     p_bld = sub.add_parser("build-cache",
-                           help="voxelize an STL class tree into an npz cache")
+                           help="voxelize an STL class tree into a packed "
+                                "voxel cache")
     p_bld.add_argument("--stl-root", required=True)
     p_bld.add_argument("--out", required=True)
     p_bld.add_argument("--resolution", type=int, default=64)
+    p_bld.add_argument("--workers", type=int, default=None,
+                       help="process-pool width for per-file voxelization "
+                            "(default: cpu count; 1 = serial)")
     p_inf = sub.add_parser("infer", allow_abbrev=False,
                            help="classify or segment STL files with a "
                                 "trained checkpoint")
@@ -324,7 +328,8 @@ def main(argv=None) -> None:
     if args.cmd == "build-cache":
         from featurenet_tpu.data.offline import build_cache
 
-        index = build_cache(args.stl_root, args.out, resolution=args.resolution)
+        index = build_cache(args.stl_root, args.out,
+                            resolution=args.resolution, workers=args.workers)
         print(json.dumps({"built": index["counts"]}))
         return
     if args.cmd == "infer":

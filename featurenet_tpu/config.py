@@ -109,6 +109,19 @@ class Config:
     checkpoint_dir: Optional[str] = None
     keep_checkpoints: int = 3
 
+    @property
+    def device_augment(self) -> bool:
+        """Whether pose augmentation runs inside the compiled train step
+        (ops/augment.py) rather than in host data workers. Single source of
+        truth shared by the Trainer and the host-feed benchmark: cache-backed
+        classification only — synthetic streaming randomizes pose at
+        generation, and segmentation must rotate per-voxel targets with the
+        part on the host."""
+        return bool(
+            self.data_cache and self.augment and self.augment_device
+            and self.augment_groups > 0 and self.task == "classify"
+        )
+
     def validate(self) -> "Config":
         if self.task not in ("classify", "segment"):
             raise ValueError(f"unknown task {self.task!r}")

@@ -41,14 +41,20 @@ VARIANTS = {
 }
 
 
-def main(batches=(128, 256), variants=None) -> list[dict]:
+def main(batches=(128, 256), variants=None, repeats: int = 3) -> list[dict]:
+    # repeats=3 per row: sweep-derived decisions (which variant becomes the
+    # flagship) must not ride on one ±13% slope sample through the tunnel
+    # (round-2 verdict weak #8); rows report best + spread_pct.
     from featurenet_tpu.benchmark import measure_train_step
 
     rows = []
     for name, arch in (variants or VARIANTS).items():
         for b in batches:
             cfg = dataclasses.replace(get_config("pod64"), arch=arch)
-            row = {"variant": name, **measure_train_step(cfg, b)}
+            row = {
+                "variant": name,
+                **measure_train_step(cfg, b, repeats=repeats),
+            }
             rows.append(row)
             print(json.dumps(row), flush=True)
     return rows

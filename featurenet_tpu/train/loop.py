@@ -122,10 +122,7 @@ class Trainer:
         rep = replicated(self.mesh)
         # Cache-backed classification augments on device (rotations inside
         # the compiled step); the host dataset then skips its rotation pass.
-        self._device_aug = bool(
-            cfg.data_cache and cfg.augment and cfg.augment_device
-            and cfg.augment_groups > 0 and cfg.task == "classify"
-        )
+        self._device_aug = cfg.device_augment
         self._train_step = jax.jit(
             make_train_step(
                 self.model, cfg.task, cfg.label_smoothing,
