@@ -192,7 +192,7 @@ def export_synthetic_cache(
     seed: int = 0,
     orient: bool = True,
 ) -> dict:
-    """Materialize the parametric generator into the npz cache format.
+    """Materialize the parametric generator into the packed cache format.
 
     Gives a *fixed* dataset (reproducible from the seed) with a stable
     train/test split downstream — the on-disk analog of the reference's
