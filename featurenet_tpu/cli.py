@@ -189,6 +189,11 @@ def main(argv=None) -> None:
     p_seg.add_argument("--resolution", type=int, default=64)
     p_seg.add_argument("--num-features", type=int, default=3)
     p_seg.add_argument("--seed", type=int, default=0)
+    p_seg.add_argument("--label-order", choices=["canonical", "generation"],
+                       default="canonical",
+                       help="overlap-voxel labeling: canonical (default) is "
+                            "deterministic given the geometry; generation "
+                            "reproduces the round-2 ambiguous dataset")
     p_stl = sub.add_parser("export-stl-data", allow_abbrev=False,
                            help="materialize the synthetic benchmark as an "
                                 "STL class tree (the reference dataset's "
@@ -197,12 +202,27 @@ def main(argv=None) -> None:
     p_stl.add_argument("--per-class", type=int, default=10)
     p_stl.add_argument("--resolution", type=int, default=64)
     p_stl.add_argument("--seed", type=int, default=0)
+    p_stl.add_argument("--seg", action="store_true",
+                       help="segmentation tree: multi-feature parts with "
+                            "per-voxel label sidecars (<part>.seg.npy)")
+    p_stl.add_argument("--num-parts", type=int, default=2400,
+                       help="(--seg) total parts in the tree")
+    p_stl.add_argument("--num-features", type=int, default=3,
+                       help="(--seg) features carved per part")
+    p_stl.add_argument("--label-order", choices=["canonical", "generation"],
+                       default="canonical",
+                       help="(--seg) overlap-voxel labeling: canonical is "
+                            "deterministic (learnable); generation "
+                            "reproduces the round-2 ambiguous dataset")
     p_bld = sub.add_parser("build-cache",
                            help="voxelize an STL class tree into a packed "
                                 "voxel cache")
     p_bld.add_argument("--stl-root", required=True)
     p_bld.add_argument("--out", required=True)
-    p_bld.add_argument("--resolution", type=int, default=64)
+    p_bld.add_argument("--resolution", type=int, default=None,
+                       help="classification trees only (default 64); a "
+                            "segmentation tree's resolution is fixed by its "
+                            "sidecars, so a contradicting flag is refused")
     p_bld.add_argument("--workers", type=int, default=None,
                        help="process-pool width for per-file voxelization "
                             "(default: cpu count; 1 = serial)")
@@ -309,7 +329,7 @@ def main(argv=None) -> None:
         index = export_seg_cache(
             args.out, num_parts=args.num_parts,
             resolution=args.resolution, num_features=args.num_features,
-            seed=args.seed,
+            seed=args.seed, label_order=args.label_order,
         )
         print(json.dumps({
             "exported": sum(s["count"] for s in index["shards"]),
@@ -317,6 +337,18 @@ def main(argv=None) -> None:
         }))
         return
     if args.cmd == "export-stl-data":
+        if args.seg:
+            from featurenet_tpu.data.voxel_to_mesh import export_seg_stl_tree
+
+            index = export_seg_stl_tree(
+                args.out, num_parts=args.num_parts,
+                resolution=args.resolution,
+                num_features=args.num_features, seed=args.seed,
+                label_order=args.label_order,
+            )
+            print(json.dumps({"exported": index["num_parts"],
+                              "kind": "segment_stl"}))
+            return
         from featurenet_tpu.data.voxel_to_mesh import export_stl_tree
 
         index = export_stl_tree(
@@ -326,10 +358,40 @@ def main(argv=None) -> None:
         print(json.dumps({"exported": index["counts"]}))
         return
     if args.cmd == "build-cache":
+        import os
+
+        # A segmentation tree (index kind "segment_stl") takes the sidecar-
+        # aware ingest; a classification class-dir tree takes build_cache.
+        tree_kind = None
+        idx_path = os.path.join(args.stl_root, "index.json")
+        if os.path.exists(idx_path):
+            with open(idx_path) as fh:
+                tree_kind = json.load(fh).get("kind")
+        if tree_kind == "segment_stl":
+            from featurenet_tpu.data.offline import build_seg_cache
+
+            if args.resolution is not None:
+                with open(idx_path) as fh:
+                    tree_res = json.load(fh).get("resolution")
+                if args.resolution != tree_res:
+                    raise SystemExit(
+                        f"--resolution {args.resolution} contradicts the "
+                        f"segmentation tree's sidecar resolution {tree_res} "
+                        "— per-voxel labels only exist at the exported "
+                        "grid; drop the flag"
+                    )
+            index = build_seg_cache(args.stl_root, args.out,
+                                    workers=args.workers)
+            print(json.dumps({
+                "built": sum(s["count"] for s in index["shards"]),
+                "kind": "segment",
+            }))
+            return
         from featurenet_tpu.data.offline import build_cache
 
         index = build_cache(args.stl_root, args.out,
-                            resolution=args.resolution, workers=args.workers)
+                            resolution=args.resolution or 64,
+                            workers=args.workers)
         print(json.dumps({"built": index["counts"]}))
         return
     if args.cmd == "infer":

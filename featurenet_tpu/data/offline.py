@@ -39,24 +39,29 @@ import numpy as np
 from featurenet_tpu.data.stl import load_stl
 from featurenet_tpu.data.synthetic import (
     CLASS_NAMES,
+    carve,
     generate_sample,
+    generate_sample_with_removals,
     pack_voxels,
     random_orientation,
 )
 from featurenet_tpu.data.voxelize import voxelize
 
 
-def _voxelize_stl_packed(args: tuple[str, int, str]) -> np.ndarray:
+def _voxelize_stl_packed(args: tuple[str, int, str, bool]) -> np.ndarray:
     """Worker: one STL file → bit-packed ``uint8 [R, R, R/8]`` occupancy.
 
     Module-level (picklable) so a multiprocessing pool can fan the
     embarrassingly-parallel per-file work out across cores; imports stay
     jax-free on this path so spawned workers start cheap and never touch
-    the device client.
+    the device client. ``normalize=False`` is the aligned-tree path
+    (segmentation sidecars must stay voxel-exact with the mesh).
     """
-    path, resolution, backend = args
+    path, resolution, backend, normalize = args
     tris = load_stl(path)
-    grid = voxelize(tris, resolution, fill=True, backend=backend)
+    grid = voxelize(
+        tris, resolution, fill=True, backend=backend, normalize=normalize
+    )
     return pack_voxels(grid)
 
 
@@ -150,7 +155,8 @@ def build_cache(
                 dtype=np.uint8,
             )
             work = [
-                (os.path.join(cdir, f), resolution, backend) for f in files
+                (os.path.join(cdir, f), resolution, backend, True)
+                for f in files
             ]
             if pool is not None:
                 rows = pool.imap(
@@ -237,6 +243,36 @@ def export_synthetic_cache(
     return index
 
 
+def _generate_seg_sample(
+    rng: np.random.Generator,
+    resolution: int,
+    num_features: int,
+    label_order: str,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One segmentation sample ``(part bool [R³], seg int32 [R³])``.
+
+    ``label_order`` picks the ground-truth labeling of voxels covered by
+    several features' removal volumes (the observable part is identical
+    either way — ``data.seg_oracle``):
+
+    - ``"canonical"``: carve in class-id-sorted order. Deterministic given
+      the part's feature multiset, so the label function is learnable; this
+      removes the order ambiguity the oracle measures (~0.10 mean-IoU at
+      the seg64 shapes) and is the default for exported datasets.
+    - ``"generation"``: the generator's draw order (round-2 behavior) — a
+      random choice among equally-valid labelings; kept for reproducing the
+      round-2 numbers and for ceiling experiments.
+    """
+    part, labels, seg, removals = generate_sample_with_removals(
+        rng, resolution, num_features=num_features
+    )
+    if label_order == "canonical":
+        _, seg = carve(labels, removals, order=np.argsort(labels, kind="stable"))
+    elif label_order != "generation":
+        raise ValueError(f"unknown label_order {label_order!r}")
+    return part, seg
+
+
 def export_seg_cache(
     out_root: str,
     num_parts: int = 2400,
@@ -244,6 +280,7 @@ def export_seg_cache(
     num_features: int = 3,
     shard_size: int = 200,
     seed: int = 0,
+    label_order: str = "canonical",
 ) -> dict:
     """Materialize multi-feature parts with per-voxel ground truth.
 
@@ -253,7 +290,9 @@ def export_seg_cache(
     format) + ``seg_{i:04d}.seg.npy`` (``int8 [N,R,R,R]``, 0 = stock/air,
     1+class = feature removal volume) pairs, mmap-read like the classify
     cache. ``index.json`` carries ``{"kind": "segment"}`` so the reader
-    picks the right dataset class.
+    picks the right dataset class. ``label_order``: see
+    ``_generate_seg_sample`` — "canonical" (default) makes overlap labels
+    deterministic; "generation" reproduces the round-2 dataset.
     """
     if resolution % 8:
         raise ValueError("resolution must be divisible by 8 (packed wire)")
@@ -265,6 +304,7 @@ def export_seg_cache(
         "num_features": num_features,
         "shards": [],
         "seed": seed,
+        "label_order": label_order,
     }
     done = 0
     shard_id = 0
@@ -276,8 +316,8 @@ def export_seg_cache(
         )
         seg = np.zeros((n, resolution, resolution, resolution), np.int8)
         for i in range(n):
-            part, _, s = generate_sample(
-                rng, resolution, num_features=num_features
+            part, s = _generate_seg_sample(
+                rng, resolution, num_features, label_order
             )
             voxels[i] = pack_voxels(part)
             seg[i] = s.astype(np.int8)
@@ -287,6 +327,136 @@ def export_seg_cache(
         index["shards"].append({"stem": stem, "count": n})
         done += n
         shard_id += 1
+    with open(os.path.join(out_root, "index.json"), "w") as fh:
+        json.dump(index, fh, indent=1)
+    return index
+
+
+def build_seg_cache(
+    stl_root: str,
+    out_root: str,
+    backend: str = "auto",
+    workers: int | None = None,
+    shard_size: int | None = None,
+) -> dict:
+    """Ingest a segmentation STL tree (mesh + per-voxel label sidecars) into
+    the packed seg-cache format ``SegCacheDataset`` reads.
+
+    The segmentation analog of ``build_cache`` — the full reference
+    modality for config 4: STL files on disk in front, voxelizing ingest in
+    the middle, mmap-read shards behind (round-2 verdict item 7). Input is
+    ``voxel_to_mesh.export_seg_stl_tree``'s layout (or anything matching
+    it: ``parts/*.stl`` with ``<stem>.seg.npy`` sidecars and an
+    ``index.json`` of kind ``segment_stl``).
+
+    Meshes are voxelized with ``normalize=False`` when the tree declares
+    ``aligned_unit_cube`` (sidecar labels live on the mesh's own voxel
+    grid; re-normalizing would shift the part against its labels — refused
+    below when the tree doesn't declare alignment, because silently
+    training on misaligned labels is the invisible kind of wrong). A
+    consistency check per part enforces the alignment: a labeled voxel
+    (seg > 0, a feature's *removed* volume) must be air in the voxelized
+    part.
+    """
+    index_path = os.path.join(stl_root, "index.json")
+    with open(index_path) as fh:
+        tree = json.load(fh)
+    if tree.get("kind") != "segment_stl":
+        raise ValueError(
+            f"{stl_root} is not a segmentation STL tree (export with "
+            "`cli export-stl-data --seg`); classification trees go "
+            "through build_cache"
+        )
+    if not tree.get("aligned_unit_cube"):
+        raise ValueError(
+            "segmentation ingest needs aligned_unit_cube trees: per-voxel "
+            "sidecars are only meaningful in the mesh's own grid frame, "
+            "and normalization would shift the part against its labels"
+        )
+    resolution = int(tree["resolution"])
+    if shard_size is None:
+        shard_size = int(tree.get("shard_size", 200))
+    pdir = os.path.join(stl_root, "parts")
+    stems = sorted(
+        f[:-4] for f in os.listdir(pdir) if f.lower().endswith(".stl")
+    )
+    if not stems:
+        raise ValueError(f"no .stl parts under {pdir}")
+    os.makedirs(out_root, exist_ok=True)
+    index = {
+        "kind": "segment",
+        "resolution": resolution,
+        "storage": "packed",
+        "num_features": tree.get("num_features"),
+        "shards": [],
+        "source": {"stl_tree": os.path.abspath(stl_root),
+                   "label_order": tree.get("label_order")},
+    }
+    if workers is None:
+        workers = os.cpu_count() or 1
+    pool = None
+    if workers > 1:
+        import multiprocessing
+
+        # spawn, not fork — same rationale as build_cache.
+        pool = multiprocessing.get_context("spawn").Pool(workers)
+    try:
+        work = [
+            (os.path.join(pdir, s + ".stl"), resolution, backend, False)
+            for s in stems
+        ]
+        if pool is not None:
+            rows = pool.imap(
+                _voxelize_stl_packed, work,
+                chunksize=max(1, len(work) // (workers * 4) or 1),
+            )
+        else:
+            rows = map(_voxelize_stl_packed, work)
+        shard_id = 0
+        vox_buf, seg_buf = [], []
+
+        def flush():
+            nonlocal shard_id
+            stem = f"seg_{shard_id:04d}"
+            np.save(os.path.join(out_root, f"{stem}.voxels.npy"),
+                    np.stack(vox_buf))
+            np.save(os.path.join(out_root, f"{stem}.seg.npy"),
+                    np.stack(seg_buf))
+            index["shards"].append({"stem": stem, "count": len(vox_buf)})
+            vox_buf.clear()
+            seg_buf.clear()
+            shard_id += 1
+
+        for stem, packed in zip(stems, rows):
+            seg = np.load(os.path.join(pdir, stem + ".seg.npy"))
+            if seg.shape != (resolution,) * 3:
+                raise ValueError(
+                    f"{stem}: sidecar shape {seg.shape} != grid "
+                    f"{(resolution,) * 3}"
+                )
+            part = np.unpackbits(packed, axis=-1).astype(bool)
+            if (part & (seg > 0)).any():
+                raise ValueError(
+                    f"{stem}: labeled voxels occupied in the voxelized "
+                    "part — mesh and sidecar are misaligned (was the tree "
+                    "exported aligned_unit_cube?)"
+                )
+            vox_buf.append(packed)
+            seg_buf.append(seg.astype(np.int8))
+            if len(vox_buf) >= shard_size:
+                flush()
+        if vox_buf:
+            flush()
+    except BaseException:
+        if pool is not None:
+            pool.terminate()
+            pool.join()
+            pool = None
+        raise
+    finally:
+        if pool is not None:
+            pool.close()
+            pool.join()
     with open(os.path.join(out_root, "index.json"), "w") as fh:
         json.dump(index, fh, indent=1)
     return index

@@ -1,0 +1,139 @@
+"""Measured IoU ceiling for segmentation under the generator's label ambiguity.
+
+The seg64 run plateaus at mean IoU ~0.80 and the round-2 claim was "label
+ambiguity, not undertraining" — asserted, never quantified (round-2 verdict
+weak item 3). This module measures the ceiling, model-free.
+
+The ambiguity mechanism is exact, not a vibe: ``generate_sample`` carves
+features in generation order and a voxel covered by several removal volumes
+keeps the *earlier* feature's label, while the observable part
+(``stock & ~union(removals)``) is order-invariant. Features are drawn iid,
+so for any permutation π of a part's features, ``carve(labels, removals, π)``
+is an *equally likely* ground truth for the *identical* input grid. No
+predictor, however good, can tell which order the generator used.
+
+Two measured numbers (both use the exact eval metric from
+``train.steps.aggregate_eval``: per-class intersection/union summed over the
+whole set, IoU per class, mean over classes present):
+
+- ``iou_random_pair`` — expected IoU between two independently ordered
+  ground truths for the same parts. This is what an ideal predictor that
+  reconstructs the geometry perfectly but guesses the order uniformly
+  scores in expectation.
+- ``iou_canonical`` — IoU of the *best deterministic tie-break* we know
+  (label multi-covered voxels by a fixed canonical order) against the
+  generator's random order. A deterministic predictor can commit to one
+  valid labeling; this is the measured ceiling for that strategy and the
+  number 0.798 should be judged against.
+
+Also reported: the ambiguous-voxel fraction (labeled voxels covered by ≥2
+removals — the voxels whose label is unknowable) and per-class ceilings so
+the step/slot families' shares are visible.
+
+Run:  python -m featurenet_tpu.data.seg_oracle [--resolution 64]
+          [--num-features 3] [--samples 1024] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from featurenet_tpu.data.synthetic import (
+    NUM_CLASSES,
+    carve,
+    generate_sample_with_removals,
+)
+
+
+def _accumulate_iou(inter, union, seg_true, seg_pred, n_cls):
+    """Add one sample's per-class intersection/union counts (exact sums,
+    same aggregation as train.steps.make_eval_step)."""
+    t = seg_true.ravel()
+    p = seg_pred.ravel()
+    agree = t == p
+    inter += np.bincount(t[agree], minlength=n_cls)[:n_cls]
+    union += (
+        np.bincount(t, minlength=n_cls)[:n_cls]
+        + np.bincount(p, minlength=n_cls)[:n_cls]
+        - np.bincount(t[agree], minlength=n_cls)[:n_cls]
+    )
+
+
+def _mean_iou(inter, union):
+    present = union > 0
+    iou = np.where(present, inter / np.maximum(union, 1), 0.0)
+    return float(iou.sum() / max(int(present.sum()), 1)), iou, present
+
+
+def measure_ceiling(
+    resolution: int = 64,
+    num_features: int = 3,
+    samples: int = 1024,
+    seed: int = 0,
+) -> dict:
+    """Monte-Carlo estimate of the ambiguity IoU ceiling. Returns a dict of
+    aggregate numbers (see module docstring)."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 7001]))
+    n_cls = NUM_CLASSES + 1  # + background
+    inter_rp = np.zeros(n_cls, np.int64)
+    union_rp = np.zeros(n_cls, np.int64)
+    inter_cn = np.zeros(n_cls, np.int64)
+    union_cn = np.zeros(n_cls, np.int64)
+    ambiguous = 0
+    labeled = 0
+    for _ in range(samples):
+        _, labels, seg, removals = generate_sample_with_removals(
+            rng, resolution, num_features=num_features
+        )
+        # Two more equally-valid ground truths for the same part: one with a
+        # fresh random order (the "another draw of the generator" labeling)
+        # and one with the canonical deterministic order (sort by class id,
+        # index-stable) a committed predictor would pick.
+        perm = rng.permutation(num_features)
+        _, seg_perm = carve(labels, removals, order=perm)
+        canon = np.argsort(labels, kind="stable")
+        _, seg_canon = carve(labels, removals, order=canon)
+        _accumulate_iou(inter_rp, union_rp, seg, seg_perm, n_cls)
+        _accumulate_iou(inter_cn, union_cn, seg, seg_canon, n_cls)
+        # Ambiguous voxels: in the part's carved region and covered by >=2
+        # removals — swapping those two features' order flips the label.
+        cover = np.zeros(seg.shape, np.int8)
+        for r in removals:
+            cover += r
+        ambiguous += int(((cover >= 2) & (seg > 0)).sum())
+        labeled += int((seg > 0).sum())
+
+    miou_rp, iou_rp, present = _mean_iou(inter_rp, union_rp)
+    miou_cn, iou_cn, _ = _mean_iou(inter_cn, union_cn)
+    return {
+        "resolution": resolution,
+        "num_features": num_features,
+        "samples": samples,
+        "iou_random_pair": round(miou_rp, 4),
+        "iou_canonical": round(miou_cn, 4),
+        "ambiguous_voxel_fraction": round(ambiguous / max(labeled, 1), 4),
+        "per_class_iou_canonical": [
+            round(float(v), 4) if p else None
+            for v, p in zip(iou_cn, present)
+        ],
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--resolution", type=int, default=64)
+    parser.add_argument("--num-features", type=int, default=3)
+    parser.add_argument("--samples", type=int, default=1024)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+    out = measure_ceiling(
+        args.resolution, args.num_features, args.samples, args.seed
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,7 @@ dataset is reproducible from a seed.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Sequence
 
 import numpy as np
 
@@ -432,6 +432,72 @@ def random_orientation(rng: np.random.Generator):
     return apply
 
 
+def carve(
+    labels: np.ndarray,
+    removals: list[np.ndarray],
+    order: Sequence[int] | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Carve feature removal volumes from stock, in ``order``.
+
+    Returns ``(part bool [R³], seg int32 [R³])``. The *part* is
+    order-invariant (``stock & ~union(removals)``); the *seg* labeling is
+    not — a voxel covered by several removals keeps the label of whichever
+    came first. Exposing the order makes that ambiguity measurable
+    (``data.seg_oracle``): any two orders are equally likely under the
+    generator's iid feature draws, so every ``carve(labels, removals, π)``
+    is an equally valid ground truth for the same observable part.
+    """
+    R = removals[0].shape[0]
+    part = stock_mask(R).copy()
+    seg = np.zeros((R, R, R), dtype=np.int32)
+    for k in order if order is not None else range(len(removals)):
+        carved = removals[k] & part
+        seg[carved] = int(labels[k]) + 1
+        part &= ~removals[k]
+    return part, seg
+
+
+def generate_sample_with_removals(
+    rng: np.random.Generator,
+    resolution: int = 64,
+    label: int | None = None,
+    num_features: int = 1,
+    orient: bool = True,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[np.ndarray]]:
+    """`generate_sample` that also returns each feature's removal volume.
+
+    Returns ``(voxels, labels, seg, removals)`` with ``removals`` a list of
+    ``bool [R³]`` grids in the *final* (post-orientation) frame and in
+    generation order, so ``carve(labels, removals)`` reproduces
+    ``(voxels, seg)`` exactly. The rng stream is identical to
+    ``generate_sample``'s — same seed, same sample.
+    """
+    R = resolution
+    labels = np.empty(num_features, dtype=np.int32)
+    removals: list[np.ndarray] = []
+
+    for k in range(num_features):
+        cls = int(rng.integers(0, NUM_CLASSES)) if label is None else int(label)
+        labels[k] = cls
+        removal = _FEATURE_FNS[cls](R, rng)
+        if num_features > 1:
+            # Re-orient each extra feature randomly so multi-feature parts
+            # don't stack every feature on the same (top/-x) faces. Overlap is
+            # possible; carving uses the *remaining* part so overlapped voxels
+            # keep the earlier feature's label.
+            removal = random_orientation(rng)(removal)
+        removals.append(removal)
+
+    if orient:
+        # The stock cube is symmetric under the cube group, so orienting the
+        # removals and carving commutes with carving then orienting — and
+        # keeps the removals aligned with the returned part/seg.
+        o = random_orientation(rng)
+        removals = [o(r) for r in removals]
+    part, seg = carve(labels, removals)
+    return part, labels, seg, removals
+
+
 def generate_sample(
     rng: np.random.Generator,
     resolution: int = 64,
@@ -446,28 +512,9 @@ def generate_sample(
     removal volume (clipped to the stock). With ``num_features == 1`` this is
     the classification sample; more features serve the segmentation config.
     """
-    R = resolution
-    part = stock_mask(R).copy()
-    seg = np.zeros((R, R, R), dtype=np.int32)
-    labels = np.empty(num_features, dtype=np.int32)
-
-    for k in range(num_features):
-        cls = int(rng.integers(0, NUM_CLASSES)) if label is None else int(label)
-        labels[k] = cls
-        removal = _FEATURE_FNS[cls](R, rng)
-        if num_features > 1:
-            # Re-orient each extra feature randomly so multi-feature parts
-            # don't stack every feature on the same (top/-x) faces. Overlap is
-            # possible; carving uses the *remaining* part so overlapped voxels
-            # keep the earlier feature's label.
-            removal = random_orientation(rng)(removal)
-        carved = removal & part
-        seg[carved] = cls + 1
-        part &= ~removal
-
-    if orient:
-        o = random_orientation(rng)
-        part, seg = o(part), o(seg)
+    part, labels, seg, _ = generate_sample_with_removals(
+        rng, resolution, label=label, num_features=num_features, orient=orient
+    )
     return part, labels, seg
 
 

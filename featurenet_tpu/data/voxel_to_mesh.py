@@ -82,6 +82,78 @@ def voxels_to_mesh(grid: np.ndarray, scale: float | None = None) -> np.ndarray:
     return (tris * np.float32(scale)).astype(np.float32)
 
 
+def export_seg_stl_tree(
+    out_root: str,
+    num_parts: int = 100,
+    resolution: int = 64,
+    num_features: int = 3,
+    shard_size: int = 200,
+    seed: int = 0,
+    label_order: str = "canonical",
+) -> dict:
+    """Materialize the segmentation benchmark as STL files + label sidecars.
+
+    The reference modality for every config: meshes on disk, ingested by the
+    voxelizing front end (SURVEY.md §3.2). Classification got that shape in
+    round 2 (``export_stl_tree``); this is the segmentation counterpart —
+    the last config that only trained from the voxel-native cache (round-2
+    verdict item 7). Layout::
+
+        out_root/index.json                  {"kind": "segment_stl", ...}
+        out_root/parts/part_0000000.stl      boundary-surface mesh, unit cube
+        out_root/parts/part_0000000.seg.npy  int8 [R,R,R] per-voxel labels
+
+    Per-voxel ground truth cannot live in the STL itself (a triangle soup
+    has no voxel identity), so each part carries a sidecar label grid in the
+    same unit-cube frame as the mesh; ``index.json``'s ``aligned_unit_cube``
+    tells the ingester (``offline.build_seg_cache``) to voxelize with
+    ``normalize=False`` so grid and sidecar stay voxel-exact (the
+    normalization margin would otherwise shift the part against its
+    labels).
+
+    Sampling uses ``export_seg_cache``'s exact per-shard seed streams, so
+    ``build_seg_cache`` over this tree reproduces the voxel-native cache of
+    the same ``(num_parts, resolution, num_features, seed, label_order)``
+    bit-for-bit — tested.
+    """
+    import json
+    import os
+
+    from featurenet_tpu.data.offline import _generate_seg_sample
+    from featurenet_tpu.data.stl import save_stl
+
+    pdir = os.path.join(out_root, "parts")
+    os.makedirs(pdir, exist_ok=True)
+    done = 0
+    shard_id = 0
+    while done < num_parts:
+        n = min(shard_size, num_parts - done)
+        rng = np.random.default_rng(np.random.SeedSequence([seed, shard_id]))
+        for i in range(n):
+            part, seg = _generate_seg_sample(
+                rng, resolution, num_features, label_order
+            )
+            stem = os.path.join(pdir, f"part_{done + i:07d}")
+            save_stl(stem + ".stl", voxels_to_mesh(part),
+                     name=f"part_{done + i}")
+            np.save(stem + ".seg.npy", seg.astype(np.int8))
+        done += n
+        shard_id += 1
+    index = {
+        "kind": "segment_stl",
+        "resolution": resolution,
+        "num_parts": num_parts,
+        "num_features": num_features,
+        "shard_size": shard_size,
+        "seed": seed,
+        "label_order": label_order,
+        "aligned_unit_cube": True,
+    }
+    with open(os.path.join(out_root, "index.json"), "w") as fh:
+        json.dump(index, fh, indent=1)
+    return index
+
+
 def export_stl_tree(
     out_root: str,
     per_class: int = 10,

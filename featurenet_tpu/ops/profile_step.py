@@ -1,33 +1,56 @@
-"""Layer-wise timing breakdown of the pod64 train step.
+"""Layer-wise timing breakdown of a classifier train step, any preset.
 
-Run on TPU:  python -m featurenet_tpu.ops.profile_step [--batch 128]
+Run on TPU:  python -m featurenet_tpu.ops.profile_step [--preset turbo64]
+                                                       [--batch 256]
 
-Answers "where do the milliseconds of the flagship step go" without XProf
-(the tunneled backend exposes no trace viewer): slope-times, at the pod64
-shapes, (a) prefix stacks of the conv tower forward, (b) the full forward,
-(c) the full fwd+bwd, and (d) the complete train step (fwd+bwd+opt+BN+
-unpack). Differences between consecutive prefixes attribute forward time to
-individual blocks; (c)-(b) is the backward cost; (d)-(c) is optimizer +
-wire-unpack + augmentation overhead. Results drive backend defaults the same
-way `ops/bench_ops.py` does (BASELINE.md).
+Answers "where do the milliseconds of the step go" without XProf (the
+tunneled backend exposes no trace viewer). Four attribution methods, all
+slope-timed at the preset's real shapes:
+
+  (a) *prefix towers*, eval-mode forward: consecutive deltas attribute
+      forward time per conv block (±2-3 ms tunnel noise; ranks blocks);
+  (b) *prefix towers, fwd+bwd*: grad-of-sum through each prefix — deltas
+      attribute the combined fwd+bwd cost per block, which is what actually
+      dominates a train step;
+  (c) *isolated blocks*: each ConvBNRelu rebuilt alone at its real input
+      shape, timed fwd and fwd+bwd, with conv-only dx/dw drill-down — the
+      per-block TF/s against the roofline below;
+  (d) *head + full towers*: the flatten/GAP+Dense head isolated, then the
+      full forward, full fwd+bwd, and the complete train step (unpack +
+      device augmentation + optimizer + dispatch included).
+
+The attribution check the round-2 verdict asked for: (b)'s deltas plus the
+head should cover >=90% of the full fwd+bwd; the printed summary states the
+attributed fraction explicitly.
+
+Roofline: per block we print FLOPs, bf16 bytes moved (in + out activations
++ weights), arithmetic intensity, and whether the block sits compute- or
+bandwidth-bound against TPU v5e's ridge (~197 bf16 TF/s peak / ~819 GB/s
+HBM ~= 240 FLOP/byte). MXU shape ceilings (C_out < 128 starves the systolic
+array's columns) are flagged per block since they, not bandwidth, bound the
+narrow FeatureNet channels (BASELINE.md round-2 conv2 analysis).
 
 Timing method matches the repo-root ``bench.py`` (NOT ops/bench_ops.py,
-which scan-chains): the measured fn is jitted to return ONE
-scalar; wall(k) = time for k sequential dispatches + a readback of the last
-scalar (block_until_ready returns early through the tunnel — a readback is
-the honest sync); per-call time = (wall(N+1) - wall(1)) / N, which cancels
-the constant dispatch/round-trip latency. One compile per measured shape —
-no scan chaining (compiling scans of full conv stacks proved pathologically
-slow on this toolchain).
+which scan-chains): the measured fn is jitted to return ONE scalar;
+wall(k) = time for k sequential dispatches + a readback of the last scalar
+(block_until_ready returns early through the tunnel — a readback is the
+honest sync); per-call time = (wall(N+1) - wall(1)) / N, which cancels the
+constant dispatch/round-trip latency. One compile per measured shape.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import time
 
 import numpy as np
+
+# TPU v5e single-chip roofline constants (public spec): bf16 peak and HBM BW.
+PEAK_BF16_TFLOPS = 197.0
+HBM_GBPS = 819.0
+RIDGE_FLOP_PER_BYTE = PEAK_BF16_TFLOPS * 1e12 / (HBM_GBPS * 1e9)  # ~240
 
 
 def _slope_time(fn, args, iters: int = 12, repeats: int = 3) -> float:
@@ -48,9 +71,54 @@ def _slope_time(fn, args, iters: int = 12, repeats: int = 3) -> float:
     return (wall(1 + iters) - wall(1)) / iters
 
 
+@dataclasses.dataclass
+class BlockShape:
+    """Resolved geometry of one conv block at a given input resolution."""
+
+    index: int  # 1-based
+    cin: int
+    cout: int
+    kernel: int
+    stride: int
+    s_in: int   # input spatial edge
+    s_out: int  # conv output spatial edge (pre-pool)
+    pooled: bool
+
+    @property
+    def flops(self) -> int:
+        """Forward MACs*2 of the conv itself."""
+        return 2 * self.s_out**3 * self.kernel**3 * self.cin * self.cout
+
+    def bytes_moved(self, batch: int) -> int:
+        """bf16 activation in + out + weights, per batch (fwd only)."""
+        return 2 * (
+            batch * self.s_in**3 * self.cin
+            + batch * self.s_out**3 * self.cout
+            + self.kernel**3 * self.cin * self.cout
+        )
+
+
+def resolve_blocks(arch, resolution: int) -> list[BlockShape]:
+    blocks = []
+    s = resolution
+    cin = 1
+    for i, (f, k, st, p) in enumerate(
+        zip(arch.features, arch.kernels, arch.strides, arch.pool_after), 1
+    ):
+        s_out = s // st
+        blocks.append(BlockShape(i, cin, f, k, st, s, s_out, p))
+        s = s_out // 2 if p else s_out
+        cin = f
+    return blocks
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch", type=int, default=128)
+    parser.add_argument("--preset", default="pod64")
+    parser.add_argument(
+        "--batch", type=int, default=None,
+        help="default: the preset's global_batch",
+    )
     args = parser.parse_args()
 
     import jax
@@ -59,31 +127,64 @@ def main() -> None:
     from featurenet_tpu.config import get_config
     from featurenet_tpu.data.synthetic import generate_batch, to_wire
     from featurenet_tpu.models import FeatureNet
-    from featurenet_tpu.models.featurenet import FeatureNetArch
+    from featurenet_tpu.models.featurenet import ConvBNRelu, FeatureNetArch
     from featurenet_tpu.train.state import create_state
     from featurenet_tpu.train.steps import make_optimizer, make_train_step
 
-    cfg = get_config("pod64")
-    B, R = args.batch, cfg.resolution
+    cfg = get_config(args.preset)
+    # This profiler builds classifier towers; a segment config under its
+    # name would silently profile the wrong model (advisor round-2 note on
+    # the same pattern in benchmark.py).
+    assert cfg.task == "classify", (
+        f"profile_step profiles classifiers; preset {cfg.name!r} is "
+        f"task={cfg.task!r}"
+    )
+    B = args.batch if args.batch is not None else cfg.global_batch
+    R = cfg.resolution
+    a = cfg.arch
+    blocks = resolve_blocks(a, R)
     rng = np.random.default_rng(0)
     voxels = jnp.asarray(rng.random((B, R, R, R, 1)) < 0.5, jnp.float32)
     rows = []
 
-    def record(name, sec, flops=None):
+    def record(name, sec, flops=None, extra=None):
         row = {"metric": name, "value": round(sec * 1e3, 3), "unit": "ms"}
         if flops:
             row["tflops"] = round(flops / sec / 1e12, 1)
+        if extra:
+            row.update(extra)
         rows.append(row)
         print(json.dumps(row))
 
-    # --- (a) forward prefix stacks: attribute fwd time per conv block -------
+    print(json.dumps({
+        "preset": cfg.name, "batch": B, "resolution": R,
+        "arch": {
+            "features": list(a.features), "kernels": list(a.kernels),
+            "strides": list(a.strides), "pool_after": list(a.pool_after),
+        },
+    }))
+
+    # --- roofline table (static analysis, no device) ------------------------
+    for b in blocks:
+        intensity = b.flops * B / b.bytes_moved(B)
+        mxu_cols = min(b.cout, 128) / 128
+        print(json.dumps({
+            "roofline_block": b.index,
+            "shape": f"{b.kernel}^3 {b.cin}->{b.cout} @{b.s_in}^3"
+                     + (f"/s{b.stride}" if b.stride > 1 else ""),
+            "gflops_batch": round(b.flops * B / 1e9, 1),
+            "mbytes_batch": round(b.bytes_moved(B) / 1e6, 1),
+            "intensity_flop_per_byte": round(intensity, 1),
+            "bound": "compute" if intensity > RIDGE_FLOP_PER_BYTE
+                     else "bandwidth",
+            "mxu_col_fill": round(mxu_cols, 2),
+            "shape_ceiling_tflops": round(PEAK_BF16_TFLOPS * mxu_cols, 0),
+        }))
+
+    # --- (a,b) prefix towers: per-block fwd and fwd+bwd deltas --------------
     # Tower-only prefixes (no flatten/Dense head — on a truncated stack the
     # head would flatten a huge activation and dominate the measurement).
     from flax import linen as nn
-
-    from featurenet_tpu.models.featurenet import ConvBNRelu
-
-    a = cfg.arch
 
     class Tower(nn.Module):
         arch: FeatureNetArch
@@ -96,26 +197,43 @@ def main() -> None:
             for f, k_, s, p in list(
                 zip(t.features, t.kernels, t.strides, t.pool_after)
             )[: self.blocks]:
-                x = ConvBNRelu(f, k_, s, stem_s2d=t.stem_s2d,
+                y = ConvBNRelu(f, k_, s, stem_s2d=t.stem_s2d,
                                conv_backend=t.conv_backend)(x, train)
-                if p:  # pool at the call site, same as FeatureNet
-                    x = nn.max_pool(
-                        x, window_shape=(2, 2, 2), strides=(2, 2, 2)
-                    )
+                # Residual adds mirror FeatureNet exactly — a prefix of a
+                # different (cheaper) model would corrupt the attribution.
+                if t.residual and s == 1 and x.shape[-1] == f:
+                    y = y + x
+                x = (
+                    nn.max_pool(y, window_shape=(2, 2, 2), strides=(2, 2, 2))
+                    if p
+                    else y
+                )
             return x
 
-    prev = 0.0
-    spatial = R
+    def grad_sum_fn(module, variables):
+        """Jitted fwd+bwd scalar probe: grad of sum(output) w.r.t. params,
+        reduced to one scalar so the readback-sync slope timing applies."""
+        params = variables["params"]
+        rest = {c: v for c, v in variables.items() if c != "params"}
+
+        @jax.jit
+        def fb(p, x):
+            def f(p_):
+                return jnp.sum(
+                    module.apply({"params": p_, **rest}, x, train=False)
+                ).astype(jnp.float32)
+
+            val, g = jax.value_and_grad(f)(p)
+            return val + jax.tree_util.tree_reduce(
+                lambda acc, y: acc + jnp.sum(y).astype(jnp.float32), g, 0.0
+            )
+
+        return fb, params
+
+    prev_f, prev_fb = 0.0, 0.0
     flops_prefix = 0.0
     for k in range(1, len(a.features) + 1):
-        spatial //= a.strides[k - 1]  # output spatial of this block
-        cin = 1 if k == 1 else a.features[k - 2]
-        flops_prefix += (
-            2 * B * spatial**3 * a.kernels[k - 1] ** 3 * cin * a.features[k - 1]
-        )
-        if a.pool_after[k - 1]:
-            spatial //= 2
-
+        flops_prefix += blocks[k - 1].flops * B
         model_k = Tower(arch=a, blocks=k)
         vs = model_k.init({"params": jax.random.key(0)}, voxels, train=False)
 
@@ -125,10 +243,111 @@ def main() -> None:
 
         t = _slope_time(fwd_sum, (vs, voxels))
         record(f"fwd_prefix_{k}blocks", t, flops_prefix)
-        record(f"fwd_block_{k}_delta", t - prev)
-        prev = t
+        record(f"fwd_block_{k}_delta", t - prev_f)
+        prev_f = t
 
-    # --- (b,c) full forward vs fwd+bwd --------------------------------------
+        # fwd+bwd through the same prefix: grad of sum w.r.t. params. Eval-
+        # mode BN (running stats) so no mutable collection threads through
+        # grad; the conv/BN-scale backward cost — the expensive part — is
+        # identical in train mode.
+        fb, params_k = grad_sum_fn(model_k, vs)
+        t2 = _slope_time(fb, (params_k, voxels))
+        record(f"fwdbwd_prefix_{k}blocks", t2, 3 * flops_prefix)
+        record(f"fwdbwd_block_{k}_delta", t2 - prev_fb)
+        prev_fb = t2
+    tower_fb_total = prev_fb
+
+    # --- (c) isolated blocks at real shapes, with conv dx/dw drill-down -----
+    for b in blocks:
+        x_in = jnp.asarray(
+            rng.random((B, b.s_in, b.s_in, b.s_in, b.cin)) < 0.5, jnp.bfloat16
+        )
+        blk = ConvBNRelu(b.cout, b.kernel, b.stride,
+                         stem_s2d=a.stem_s2d, conv_backend=a.conv_backend)
+        vs = blk.init({"params": jax.random.key(0)}, x_in, train=False)
+        params_b = vs["params"]
+        rest_b = {c: v for c, v in vs.items() if c != "params"}
+
+        @jax.jit
+        def blk_fwd(p, x, _b=blk, _rest=rest_b):
+            return jnp.sum(
+                _b.apply({"params": p, **_rest}, x, train=False)
+            ).astype(jnp.float32)
+
+        t_f = _slope_time(blk_fwd, (params_b, x_in))
+        record(f"iso_block_{b.index}_fwd", t_f, b.flops * B)
+
+        fb_b, _ = grad_sum_fn(blk, vs)
+        t_fb = _slope_time(fb_b, (params_b, x_in))
+        record(f"iso_block_{b.index}_fwdbwd", t_fb, 3 * b.flops * B)
+
+        # Conv-only dx / dw (the MXU contractions, no BN/relu): where the
+        # round-2 analysis found the 25%-of-peak dW shape ceiling.
+        conv = nn.Conv(
+            b.cout, kernel_size=(b.kernel,) * 3, strides=(b.stride,) * 3,
+            padding="SAME", use_bias=False, dtype=jnp.bfloat16,
+            param_dtype=jnp.float32,
+        )
+        cvars = conv.init(jax.random.key(0), x_in)
+
+        @jax.jit
+        def conv_dx(p, x, _c=conv):
+            g = jax.grad(
+                lambda x_: jnp.sum(_c.apply(p, x_)).astype(jnp.float32)
+            )(x)
+            return jnp.sum(g).astype(jnp.float32)
+
+        @jax.jit
+        def conv_dw(p, x, _c=conv):
+            g = jax.grad(
+                lambda p_: jnp.sum(_c.apply(p_, x)).astype(jnp.float32)
+            )(p)
+            return jax.tree_util.tree_reduce(
+                lambda acc, y: acc + jnp.sum(y).astype(jnp.float32), g, 0.0
+            )
+
+        record(f"iso_block_{b.index}_conv_dx",
+               _slope_time(conv_dx, (cvars, x_in)), b.flops * B)
+        record(f"iso_block_{b.index}_conv_dw",
+               _slope_time(conv_dw, (cvars, x_in)), b.flops * B)
+
+    # --- (d) head isolated, then full model ---------------------------------
+    last = blocks[-1]
+    s_head = last.s_out // 2 if last.pooled else last.s_out
+    head_in = jnp.asarray(
+        rng.random((B, s_head, s_head, s_head, last.cout)) < 0.5, jnp.bfloat16
+    )
+
+    class Head(nn.Module):
+        arch: FeatureNetArch
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            t = self.arch
+            if t.head_gap:
+                x = jnp.mean(x, axis=(1, 2, 3), dtype=jnp.float32).astype(
+                    jnp.bfloat16
+                )
+            else:
+                x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(t.hidden, dtype=jnp.bfloat16,
+                         param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+            x = nn.Dense(t.num_classes, dtype=jnp.bfloat16,
+                         param_dtype=jnp.float32)(x)
+            return x.astype(jnp.float32)
+
+    head = Head(arch=a)
+    hvars = head.init(jax.random.key(0), head_in)
+    # Dense-1's contraction is over the GAP vector (cout) for GAP heads and
+    # the full flattened activation for paper-shape heads.
+    d1_in = last.cout if a.head_gap else s_head**3 * last.cout
+    head_flops = 2 * B * (d1_in * a.hidden + a.hidden * a.num_classes)
+    head_fb, hparams = grad_sum_fn(head, hvars)
+    t_head = _slope_time(head_fb, (hparams, head_in))
+    record("head_fwdbwd", t_head, 3 * head_flops)
+
+    # --- full forward vs fwd+bwd --------------------------------------------
     model = FeatureNet(arch=a)
     variables = model.init({"params": jax.random.key(0)}, voxels, train=False)
     params = variables["params"]
@@ -165,7 +384,7 @@ def main() -> None:
     record("full_fwd_bwd", t_fb)
     record("bwd_delta", t_fb - t_fwd)
 
-    # --- (d) complete train step (unpack+augment+opt included) --------------
+    # --- complete train step (unpack+augment+opt included) ------------------
     tx = make_optimizer(cfg)
     state = create_state(model, tx, voxels, jax.random.key(0))
     wire = to_wire(generate_batch(rng, B, R), "classify")
@@ -186,6 +405,19 @@ def main() -> None:
     record("train_step_total_incl_dispatch", best)
     record("overhead_opt_unpack_aug_dispatch", best - t_fb)
 
+    # --- attribution check: how much of fwd+bwd do the parts explain? -------
+    attributed = tower_fb_total + t_head
+    print(json.dumps({
+        "attribution": {
+            "tower_fwdbwd_ms": round(tower_fb_total * 1e3, 2),
+            "head_fwdbwd_ms": round(t_head * 1e3, 2),
+            "sum_parts_ms": round(attributed * 1e3, 2),
+            "full_fwdbwd_ms": round(t_fb * 1e3, 2),
+            "attributed_pct": round(100 * attributed / t_fb, 1),
+            "note": "parts exclude the loss/softmax and cross-prefix XLA "
+                    "fusion differences; >=90% closes the verdict ask",
+        }
+    }))
     print(json.dumps({"summary": rows}))
 
 

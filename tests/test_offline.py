@@ -452,3 +452,82 @@ def test_measure_host_feed_matches_trainer_policy(tmp_path):
     )
     assert r3["host_augment"] is True
     assert r3["host_samples_per_sec"] > 0
+
+
+def test_canonical_label_order_removes_order_ambiguity(tmp_path):
+    """Canonical-order export differs from generation-order only on
+    multi-covered voxels, and is deterministic given the geometry."""
+    from featurenet_tpu.data.offline import _generate_seg_sample
+
+    rng1 = np.random.default_rng(5)
+    rng2 = np.random.default_rng(5)
+    p_gen, s_gen = _generate_seg_sample(rng1, 16, 3, "generation")
+    p_can, s_can = _generate_seg_sample(rng2, 16, 3, "canonical")
+    assert (p_gen == p_can).all()  # identical observable part
+    diff = s_gen != s_can
+    # Wherever they differ, both label a feature voxel (never background).
+    assert np.all((s_gen[diff] > 0) & (s_can[diff] > 0))
+
+
+def test_seg_stl_tree_ingest_reproduces_voxel_cache(tmp_path):
+    """export_seg_stl_tree → build_seg_cache == export_seg_cache, bit for
+    bit (the STL modality and the voxel-native cache are the same dataset),
+    and the result trains through SegCacheDataset."""
+    from featurenet_tpu.data.offline import (
+        SegCacheDataset,
+        build_seg_cache,
+        export_seg_cache,
+    )
+    from featurenet_tpu.data.voxel_to_mesh import export_seg_stl_tree
+
+    native = str(tmp_path / "native")
+    export_seg_cache(native, num_parts=12, resolution=16, num_features=2,
+                     shard_size=5, seed=6)
+    tree = str(tmp_path / "tree")
+    export_seg_stl_tree(tree, num_parts=12, resolution=16, num_features=2,
+                        shard_size=5, seed=6)
+    built = str(tmp_path / "built")
+    index = build_seg_cache(tree, built, workers=1)
+    assert sum(s["count"] for s in index["shards"]) == 12
+    for stem in ("seg_0000", "seg_0001", "seg_0002"):
+        for suffix in (".voxels.npy", ".seg.npy"):
+            a = np.load(os.path.join(native, stem + suffix))
+            b = np.load(os.path.join(built, stem + suffix))
+            assert (np.asarray(a) == np.asarray(b)).all(), (stem, suffix)
+    ds = SegCacheDataset(built, global_batch=4, split="train",
+                         test_fraction=0.25)
+    b = next(iter(ds))
+    assert b["voxels"].shape == (4, 16, 16, 2)
+    assert b["seg"].dtype == np.int8
+
+
+def test_build_seg_cache_refuses_misaligned_sidecars(tmp_path):
+    """A sidecar labeling voxels that are occupied in the voxelized mesh is
+    a hard error — silently training on shifted labels is invisible."""
+    from featurenet_tpu.data.offline import build_seg_cache
+    from featurenet_tpu.data.voxel_to_mesh import export_seg_stl_tree
+
+    tree = str(tmp_path / "tree")
+    export_seg_stl_tree(tree, num_parts=2, resolution=16, num_features=2,
+                        seed=1)
+    # Corrupt one sidecar: label a voxel that is solid in the part.
+    stem = os.path.join(tree, "parts", "part_0000000")
+    import numpy as np2
+
+    from featurenet_tpu.data.stl import load_stl
+    from featurenet_tpu.data.voxelize import voxelize
+
+    part = voxelize(load_stl(stem + ".stl"), 16, fill=True, normalize=False)
+    seg = np2.load(stem + ".seg.npy")
+    solid = np2.argwhere(part)
+    seg[tuple(solid[0])] = 3
+    np2.save(stem + ".seg.npy", seg)
+    with pytest.raises(ValueError, match="misaligned"):
+        build_seg_cache(tree, str(tmp_path / "out"), workers=1)
+
+
+def test_build_seg_cache_refuses_classify_tree(stl_tree, tmp_path):
+    from featurenet_tpu.data.offline import build_seg_cache
+
+    with pytest.raises((ValueError, FileNotFoundError)):
+        build_seg_cache(stl_tree, str(tmp_path / "out"), workers=1)
