@@ -362,24 +362,22 @@ def main(argv=None) -> None:
 
         # A segmentation tree (index kind "segment_stl") takes the sidecar-
         # aware ingest; a classification class-dir tree takes build_cache.
-        tree_kind = None
+        tree = {}
         idx_path = os.path.join(args.stl_root, "index.json")
         if os.path.exists(idx_path):
             with open(idx_path) as fh:
-                tree_kind = json.load(fh).get("kind")
-        if tree_kind == "segment_stl":
+                tree = json.load(fh)
+        if tree.get("kind") == "segment_stl":
             from featurenet_tpu.data.offline import build_seg_cache
 
-            if args.resolution is not None:
-                with open(idx_path) as fh:
-                    tree_res = json.load(fh).get("resolution")
-                if args.resolution != tree_res:
-                    raise SystemExit(
-                        f"--resolution {args.resolution} contradicts the "
-                        f"segmentation tree's sidecar resolution {tree_res} "
-                        "— per-voxel labels only exist at the exported "
-                        "grid; drop the flag"
-                    )
+            if (args.resolution is not None
+                    and args.resolution != tree.get("resolution")):
+                raise SystemExit(
+                    f"--resolution {args.resolution} contradicts the "
+                    f"segmentation tree's sidecar resolution "
+                    f"{tree.get('resolution')} — per-voxel labels only "
+                    "exist at the exported grid; drop the flag"
+                )
             index = build_seg_cache(args.stl_root, args.out,
                                     workers=args.workers)
             print(json.dumps({

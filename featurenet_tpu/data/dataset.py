@@ -89,23 +89,92 @@ class _WorkerDone:
     pass
 
 
+# (sharding, local_shape) -> slices; tiny, but put_batch is per-step.
+_block_cache: dict = {}
+
+
+def _local_block(sharding, local_shape: tuple) -> tuple:
+    """The slices of the host feed this process's devices actually need.
+
+    The feed produces its data-row group's rows at *full* spatial extent
+    (``parallel.mesh.feed_shards``). When every non-batch dim is unsharded
+    — pure DP, or tensor parallelism on params only — the block is the
+    whole feed and this returns full slices. Under spatial sharding with
+    the ``model`` axis spanning processes, this process's devices hold only
+    a depth sub-range of the shared rows, and
+    ``make_array_from_process_local_data`` expects exactly that block — so
+    the feed must be sliced before assembly. Computed once per
+    (sharding, shape) from the sharding's own index map; cached because it
+    runs per training step.
+    """
+    import jax
+
+    key = (sharding, tuple(local_shape))
+    hit = _block_cache.get(key)
+    if hit is not None:
+        return hit
+
+    # Global rows: the feed's row count covers this process's k data rows
+    # out of the data axis' total.
+    mesh = sharding.mesh
+    p = jax.process_index()
+    grid = mesh.devices
+    k = sum(
+        1 for r in range(grid.shape[0])
+        if any(d.process_index == p for d in grid[r].flat)
+    )
+    global_rows = local_shape[0] * grid.shape[0] // k
+    global_shape = (global_rows,) + tuple(local_shape[1:])
+    imap = sharding.devices_indices_map(global_shape)
+    mine = [imap[d] for d in sharding.addressable_devices]
+    out = []
+    for dim in range(len(global_shape)):
+        starts = [s[dim].start or 0 for s in mine]
+        stops = [
+            s[dim].stop if s[dim].stop is not None else global_shape[dim]
+            for s in mine
+        ]
+        lo, hi = min(starts), max(stops)
+        if dim == 0:
+            # Rows: the feed is exactly this block; keep feed-relative.
+            if hi - lo != local_shape[0]:
+                raise ValueError(
+                    f"feed rows {local_shape[0]} != addressable row block "
+                    f"{hi - lo}; dataset sharding must use "
+                    "parallel.mesh.feed_shards"
+                )
+            out.append(slice(None))
+        else:
+            out.append(slice(lo, hi) if (lo, hi) != (0, global_shape[dim])
+                       else slice(None))
+    _block_cache[key] = tuple(out)
+    return _block_cache[key]
+
+
 def put_batch(batch, sharding):
     """Place a host-local batch under a (possibly multi-host) sharding.
 
-    Single-process: plain ``device_put``. Multi-process: each host holds only
-    its slice of the global batch, so the global array is assembled from
-    process-local shards (``make_array_from_process_local_data``) — the
-    device_put path would wrongly treat the local slice as the global array.
+    Single-process: plain ``device_put``. Multi-process: each host holds
+    only its data-row group of the global batch, so the global array is
+    assembled from process-local blocks
+    (``make_array_from_process_local_data``) — the device_put path would
+    wrongly treat the local slice as the global array. ``_local_block``
+    narrows the feed to the addressable sub-block first, which is what
+    makes meshes whose ``model`` axis spans processes (tensor-parallel
+    kernels, spatially-sharded 128³ grids) assemble correctly.
     """
     import jax
 
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.make_array_from_process_local_data(s, x),
-        batch,
-        sharding,
-    )
+
+    def assemble(x, s):
+        block = _local_block(s, x.shape)
+        if any(b != slice(None) for b in block):
+            x = np.ascontiguousarray(x[block])
+        return jax.make_array_from_process_local_data(s, x)
+
+    return jax.tree_util.tree_map(assemble, batch, sharding)
 
 
 def prefetch_to_device(

@@ -436,6 +436,7 @@ def carve(
     labels: np.ndarray,
     removals: list[np.ndarray],
     order: Sequence[int] | None = None,
+    resolution: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Carve feature removal volumes from stock, in ``order``.
 
@@ -446,7 +447,14 @@ def carve(
     (``data.seg_oracle``): any two orders are equally likely under the
     generator's iid feature draws, so every ``carve(labels, removals, π)``
     is an equally valid ground truth for the same observable part.
+    ``resolution`` is only needed for the degenerate no-features case
+    (plain stock, all-zero seg).
     """
+    if not len(removals):
+        if resolution is None:
+            raise ValueError("carve with no removals needs resolution")
+        R = resolution
+        return stock_mask(R).copy(), np.zeros((R, R, R), dtype=np.int32)
     R = removals[0].shape[0]
     part = stock_mask(R).copy()
     seg = np.zeros((R, R, R), dtype=np.int32)
@@ -494,7 +502,7 @@ def generate_sample_with_removals(
         # keeps the removals aligned with the returned part/seg.
         o = random_orientation(rng)
         removals = [o(r) for r in removals]
-    part, seg = carve(labels, removals)
+    part, seg = carve(labels, removals, resolution=R)
     return part, labels, seg, removals
 
 

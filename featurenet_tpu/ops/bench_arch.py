@@ -38,6 +38,32 @@ VARIANTS = {
     "wide_k3": dataclasses.replace(
         FeatureNetArch(), features=(64, 64, 128, 128), kernels=(7, 3, 3, 3)
     ),
+    # turbo64 as shipped: 7^3/s2 stem -> pool -> 3^3 blocks at 16^3.
+    "turbo": dataclasses.replace(
+        FeatureNetArch(), kernels=(7, 3, 3, 3),
+        pool_after=(True, False, False, True),
+    ),
+    # Round-3 profiler levers (BASELINE.md "where turbo64's ms go"): the
+    # stem is 43% of fwd+bwd at its Cout=32 shape ceiling, and the flatten
+    # head is ~14% at 1.2 TF/s.
+    # s4: same 7^3 receptive field, stride 4 -> 16^3 directly (1/8 the stem
+    # FLOPs of turbo's stem+pool route; pooling after a stride-2 stem
+    # computes 8 voxels then discards 7).
+    "s4": dataclasses.replace(
+        FeatureNetArch(), kernels=(7, 3, 3, 3), strides=(4, 1, 1, 1),
+        pool_after=(False, False, False, True),
+    ),
+    # s4 + GAP head: kills the 32768-wide flatten Dense (thin-K dW, 16.8 MB
+    # fp32 params) in favor of a 64-vector head.
+    "s4_gap": dataclasses.replace(
+        FeatureNetArch(), kernels=(7, 3, 3, 3), strides=(4, 1, 1, 1),
+        pool_after=(False, False, False, True), head_gap=True,
+    ),
+    # GAP alone (stem unchanged) to separate the two levers' contributions.
+    "turbo_gap": dataclasses.replace(
+        FeatureNetArch(), kernels=(7, 3, 3, 3),
+        pool_after=(True, False, False, True), head_gap=True,
+    ),
 }
 
 

@@ -355,32 +355,37 @@ def main() -> None:
     labels = jnp.asarray(rng.integers(0, a.num_classes, B), jnp.int32)
     drng = jax.random.key(1)
 
-    def loss_fn(params, bs):
+    # Inputs travel as jit arguments, never closures: a closed-over batch
+    # becomes a compile-time constant shipped inside the compile request,
+    # and at batch 256 x 64^3 that 268 MB body overflows the tunnel's
+    # remote-compile length limit (HTTP 413, observed).
+    def loss_fn(params, bs, vox, lab):
         import optax
 
         logits, new_vars = model.apply(
-            {"params": params, "batch_stats": bs}, voxels, train=True,
+            {"params": params, "batch_stats": bs}, vox, train=True,
             mutable=["batch_stats"], rngs={"dropout": drng},
         )
         return optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels
+            logits, lab
         ).mean(), new_vars
 
     t_fwd = _slope_time(
-        jax.jit(lambda p, bs: loss_fn(p, bs)[0]), (params, batch_stats)
+        jax.jit(lambda p, bs, v, l: loss_fn(p, bs, v, l)[0]),
+        (params, batch_stats, voxels, labels),
     )
     record("full_fwd_train", t_fwd)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     @jax.jit
-    def fwdbwd(p, bs):
-        (loss, _), grads = grad_fn(p, bs)
+    def fwdbwd(p, bs, v, l):
+        (loss, _), grads = grad_fn(p, bs, v, l)
         return loss + jax.tree_util.tree_reduce(
             lambda x, y: x + jnp.sum(y).astype(jnp.float32), grads, 0.0
         )
 
-    t_fb = _slope_time(fwdbwd, (params, batch_stats))
+    t_fb = _slope_time(fwdbwd, (params, batch_stats, voxels, labels))
     record("full_fwd_bwd", t_fb)
     record("bwd_delta", t_fb - t_fwd)
 

@@ -33,6 +33,7 @@ from featurenet_tpu.models.segmenter import FeatureNetSegmenter
 from featurenet_tpu.parallel.mesh import (
     batch_shardings,
     clamp_model_axis,
+    feed_shards,
     make_mesh,
     replicated,
     state_shardings,
@@ -151,10 +152,14 @@ class Trainer:
         )()
 
         # --- data -----------------------------------------------------------
-        # Each host generates only its 1/process_count slice of the global
-        # batch (the DistributedSampler analog); put_batch then assembles
-        # the globally-sharded array from per-host slices.
-        n_hosts, host_id = jax.process_count(), jax.process_index()
+        # Each host generates exactly the data-row group its devices touch
+        # (the DistributedSampler analog); put_batch then assembles the
+        # globally-sharded array from per-host blocks. feed_shards — not
+        # (process_count, process_index) — because with the model axis
+        # spanning processes several hosts share one row group and must
+        # feed identical rows (parallel.mesh.feed_shards).
+        n_hosts, host_id = feed_shards(self.mesh)
+        self._feed = (n_hosts, host_id)
         if cfg.data_cache and cfg.task == "segment":
             from featurenet_tpu.data.offline import SegCacheDataset
 
@@ -260,10 +265,13 @@ class Trainer:
             # masked sums count every sample exactly once and eval wall
             # time scales 1/process_count (round 1 walked the full epoch on
             # every host, process_count-times redundant).
+            # Decimate by *feed group*, not process: hosts sharing a data-
+            # row group (model axis spanning processes) must walk identical
+            # batches or put_batch would assemble mismatched rows.
             batches = self.eval_data.epoch_batches(
                 self.eval_data.local_batch,
-                num_shards=jax.process_count(),
-                shard_id=jax.process_index(),
+                num_shards=self._feed[0],
+                shard_id=self._feed[1],
             )
         else:
             it = iter(self.eval_data)

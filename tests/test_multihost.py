@@ -24,14 +24,17 @@ import sys
 
 
 _WORKER = r"""
-import json, sys
-pid, nproc, port, steps, cache = (
-    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
-    sys.argv[5],
-)
+import json, os, sys
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+over = json.loads(sys.argv[4])
+want_eval = over.pop("_eval", False)
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 2)
+repo = os.environ["PYTHONPATH"].split(os.pathsep)[0]  # set by the test
+cache_dir = os.path.join(repo, ".cache", "jax_compile")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.distributed.initialize(
     coordinator_address=f"127.0.0.1:{port}",
     num_processes=nproc,
@@ -43,34 +46,33 @@ assert len(jax.devices()) == 2 * nproc, jax.devices()
 from featurenet_tpu.config import get_config
 from featurenet_tpu.train.loop import Trainer
 
-cfg = get_config(
-    "smoke16",
-    global_batch=8,
-    total_steps=steps,
-    data_workers=1,
-    log_every=1,
-    eval_every=10**9,
-    checkpoint_every=10**9,
-    eval_batches=1,
-    data_cache=cache or None,
-)
+base = dict(data_workers=1, log_every=1, eval_every=10**9,
+            checkpoint_every=10**9, eval_batches=1)
+base.update(over)
+cfg = get_config("smoke16", **base)
 trainer = Trainer(cfg)
-last = trainer.run()
+try:
+    last = trainer.run()
+except SystemExit as e:
+    # Planned-restart segment boundary: report and propagate the exit code
+    # so the harness (playing supervisor) can respawn the process group.
+    print("RESTART_EXIT " + json.dumps({
+        "code": int(e.code), "step": int(trainer.state.step)}))
+    raise
 print("FINAL " + json.dumps(
     {k: float(v) for k, v in last.items()
      if isinstance(v, (int, float)) and not isinstance(v, bool)}
 ))
-if cache:
-    # Host-sharded exact eval: each host walks its decimation of the
+if want_eval:
+    # Host-sharded exact eval: each feed group walks its decimation of the
     # held-out split; global sums must agree bitwise AND count every
     # sample exactly once (the confusion total is the proof).
     import numpy as np
     ev = trainer.evaluate()
-    print("EVAL " + json.dumps({
-        "accuracy": ev["accuracy"],
-        "loss": ev["loss"],
-        "n_evaluated": int(np.asarray(ev["confusion"]).sum()),
-    }))
+    out = {"accuracy": ev["accuracy"], "loss": ev["loss"]}
+    if "confusion" in ev:
+        out["n_evaluated"] = int(np.asarray(ev["confusion"]).sum())
+    print("EVAL " + json.dumps(out))
 """
 
 
@@ -81,14 +83,15 @@ def _free_port() -> int:
 
 
 def _run_workers(
-    port: int, steps: int, nproc: int, cache: str = ""
-) -> list[str]:
+    port: int, nproc: int, overrides: dict | None = None
+) -> tuple[list[str], list[int]]:
     """Spawn, concurrently drain, and always reap the worker processes.
 
     Concurrent draining matters: a worker that fills its unread stdout pipe
     blocks, stalling its peer at the next collective. The finally block
     guarantees no orphan survives a timeout or assertion (an orphan would
-    pin the coordinator port and wedge later runs).
+    pin the coordinator port and wedge later runs). Returns (stdouts,
+    returncodes) — planned-restart segments exit 75 on purpose.
     """
     import threading
 
@@ -100,10 +103,11 @@ def _run_workers(
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "",
     }
+    blob = json.dumps(overrides or {})
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, str(i), str(nproc), str(port),
-             str(steps), cache],
+             blob],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -140,7 +144,7 @@ def _run_workers(
                 p.kill()
         for t in threads:
             t.join(timeout=30)
-    return outs
+    return outs, [p.returncode for p in procs]
 
 
 def test_two_process_training_stays_in_sync(tmp_path):
@@ -153,12 +157,14 @@ def test_two_process_training_stays_in_sync(tmp_path):
     export_synthetic_cache(cache, per_class=2, resolution=16)
     held_out = len(VoxelCacheDataset(cache, global_batch=8, split="test"))
 
-    steps, nproc = 3, 2
+    nproc = 2
+    over = {"global_batch": 8, "total_steps": 3, "data_cache": cache,
+            "_eval": True}
     outs = []
     # The free-port probe races with the coordinator's bind (TOCTOU);
     # retry once on a fresh port if the rendezvous itself failed to bind.
     for attempt in range(2):
-        outs = _run_workers(_free_port(), steps, nproc, cache=cache)
+        outs, _ = _run_workers(_free_port(), nproc, over)
         if not any("ddress already in use" in o for o in outs):
             break
     for i, out in enumerate(outs):
@@ -187,3 +193,92 @@ def test_two_process_training_stays_in_sync(tmp_path):
     # counted exactly once (the round-1 path counted them nproc times).
     assert evals[0] == evals[1], evals
     assert evals[0]["n_evaluated"] == held_out, (evals, held_out)
+
+
+def _collect(outs: list[str], tag: str) -> list[dict]:
+    vals = []
+    for i, out in enumerate(outs):
+        lines = [l for l in out.splitlines() if l.startswith(tag + " ")]
+        assert lines, f"worker {i}: no {tag}:\n{out[-2000:]}"
+        vals.append(json.loads(lines[-1][len(tag) + 1:]))
+    return vals
+
+
+def _retry_port(nproc: int, over: dict) -> tuple[list[str], list[int]]:
+    """Retry once on rendezvous-infrastructure failures: a TOCTOU-raced
+    coordinator port, or a gloo key-value DEADLINE_EXCEEDED when many
+    workers cold-compile on one oversubscribed core (observed flake — the
+    30s handshake budget, not a logic bug)."""
+    for attempt in range(3):
+        outs, codes = _run_workers(_free_port(), nproc, over)
+        transient = any(
+            "ddress already in use" in o or "DEADLINE_EXCEEDED" in o
+            for o in outs
+        )
+        if not transient:
+            return outs, codes
+    return outs, codes
+
+
+def test_four_process_model_axis_spans_processes():
+    """mesh_model=4 over 4 hosts x 2 devices: tensor-parallel kernels and
+    the spatially-sharded depth axis both span process boundaries, so every
+    model-axis collective (column-parallel matmuls, conv halo exchange)
+    rides the cross-process path, and hosts in the same data-row group must
+    feed identical rows with put_batch narrowing each to its depth block
+    (parallel.mesh.feed_shards + dataset._local_block — the round-2
+    verdict's untested case)."""
+    nproc = 4
+    over = {"global_batch": 8, "total_steps": 2, "mesh_model": 4,
+            "spatial": True}
+    outs, codes = _retry_port(nproc, over)
+    for i, out in enumerate(outs):
+        assert "FINAL " in out, f"worker {i} failed:\n{out[-2000:]}"
+    assert codes == [0] * nproc
+    finals = _collect(outs, "FINAL")
+    for f in finals[1:]:
+        for k in finals[0]:
+            if k == "samples_per_sec":
+                continue
+            assert f[k] == finals[0][k], (k, finals)
+    assert finals[0]["loss"] > 0.0
+
+
+def test_multiprocess_checkpoint_resume_and_planned_restart(tmp_path):
+    """The C5 production path, multi-process: a segmented run checkpoints,
+    the whole process group exits 75 (planned restart), a fresh group
+    resumes from the Orbax checkpoint + config sidecar and finishes. Covers
+    Orbax save/restore coordination across processes and the supervisor
+    handoff (the harness plays the per-deployment supervisor)."""
+    nproc = 2
+    ckpt = str(tmp_path / "ck")
+    over = {"global_batch": 8, "total_steps": 5, "checkpoint_every": 2,
+            "checkpoint_dir": ckpt, "restart_every_steps": 3}
+    # Segment 1: trains to step 3, saves, exits RESTART_EXIT_CODE (75).
+    outs, codes = _retry_port(nproc, over)
+    assert codes == [75] * nproc, (codes, [o[-1500:] for o in outs])
+    restarts = _collect(outs, "RESTART_EXIT")
+    assert all(r == {"code": 75, "step": 3} for r in restarts), restarts
+    # Segment 2: a fresh process group must RESUME at step 3 (not retrain
+    # from 0) and complete to 5 with bitwise-identical global metrics.
+    outs, codes = _retry_port(nproc, over)
+    assert codes == [0] * nproc, (codes, [o[-1500:] for o in outs])
+    finals = _collect(outs, "FINAL")
+    assert finals[0] == finals[1] or all(
+        finals[0][k] == finals[1][k]
+        for k in finals[0] if k != "samples_per_sec"
+    ), finals
+    # The fresh group RESUMED: every train-step log in segment 2 is past
+    # the restart point (a retrain-from-0 would log steps 1..3 again at
+    # log_every=1).
+    for out in outs:
+        steps = [
+            json.loads(l)["step"] for l in out.splitlines()
+            if l.startswith("{") and '"kind": "train"' in l
+        ]
+        assert steps and min(steps) > 3, steps
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(ckpt)
+    assert mgr.latest_step() == 5
+    mgr.close()

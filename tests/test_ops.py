@@ -26,6 +26,7 @@ def ref_conv(x, w, stride=1):
     (12, 3, 2, 1, 4),
     (9, 3, 3, 1, 4),    # stride 3, odd grid
     (8, 4, 2, 1, 4),    # even kernel
+    (16, 7, 4, 1, 8),   # stride-4 stem (round-3 s4 flagship lever)
 ])
 def test_s2d_conv_matches_direct(rng, r, k, s, cin, cout):
     x = jnp.asarray(rng.standard_normal((2, r, r, r, cin)), jnp.float32)
