@@ -114,16 +114,13 @@ def _local_block(sharding, local_shape: tuple) -> tuple:
     if hit is not None:
         return hit
 
-    # Global rows: the feed's row count covers this process's k data rows
-    # out of the data axis' total.
-    mesh = sharding.mesh
-    p = jax.process_index()
-    grid = mesh.devices
-    k = sum(
-        1 for r in range(grid.shape[0])
-        if any(d.process_index == p for d in grid[r].flat)
-    )
-    global_rows = local_shape[0] * grid.shape[0] // k
+    # Global rows = feed rows × feed groups; feed_shards is the single
+    # source of truth for the process→row-group mapping (and validates
+    # contiguity/divisibility, which a local re-derivation would skip).
+    from featurenet_tpu.parallel.mesh import feed_shards
+
+    num_groups, _ = feed_shards(sharding.mesh)
+    global_rows = local_shape[0] * num_groups
     global_shape = (global_rows,) + tuple(local_shape[1:])
     imap = sharding.devices_indices_map(global_shape)
     mine = [imap[d] for d in sharding.addressable_devices]

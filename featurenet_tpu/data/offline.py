@@ -267,7 +267,9 @@ def _generate_seg_sample(
         rng, resolution, num_features=num_features
     )
     if label_order == "canonical":
-        _, seg = carve(labels, removals, order=np.argsort(labels, kind="stable"))
+        _, seg = carve(labels, removals,
+                       order=np.argsort(labels, kind="stable"),
+                       resolution=resolution)
     elif label_order != "generation":
         raise ValueError(f"unknown label_order {label_order!r}")
     return part, seg

@@ -129,7 +129,7 @@ def _run_workers(
 
         for t in threads:
             t.start()
-        deadline = 600
+        deadline = 900
         end = time.monotonic() + deadline  # shared bound, not per-thread
         for t in threads:
             t.join(timeout=max(0.0, end - time.monotonic()))
@@ -160,13 +160,7 @@ def test_two_process_training_stays_in_sync(tmp_path):
     nproc = 2
     over = {"global_batch": 8, "total_steps": 3, "data_cache": cache,
             "_eval": True}
-    outs = []
-    # The free-port probe races with the coordinator's bind (TOCTOU);
-    # retry once on a fresh port if the rendezvous itself failed to bind.
-    for attempt in range(2):
-        outs, _ = _run_workers(_free_port(), nproc, over)
-        if not any("ddress already in use" in o for o in outs):
-            break
+    outs, _ = _retry_port(nproc, over)
     for i, out in enumerate(outs):
         assert "FINAL " in out, f"worker {i} failed:\n{out}"
 
@@ -205,19 +199,28 @@ def _collect(outs: list[str], tag: str) -> list[dict]:
 
 
 def _retry_port(nproc: int, over: dict) -> tuple[list[str], list[int]]:
-    """Retry once on rendezvous-infrastructure failures: a TOCTOU-raced
-    coordinator port, or a gloo key-value DEADLINE_EXCEEDED when many
-    workers cold-compile on one oversubscribed core (observed flake — the
-    30s handshake budget, not a logic bug)."""
+    """Retry on rendezvous-infrastructure failures: a TOCTOU-raced
+    coordinator port, a gloo key-value DEADLINE_EXCEEDED, or an outright
+    worker-group timeout — all observed when many workers cold-compile on
+    one core oversubscribed by the rest of the suite (infrastructure
+    flakes, not logic bugs; a logic failure reproduces on the retry)."""
+    last_err, last_result = None, None
     for attempt in range(3):
-        outs, codes = _run_workers(_free_port(), nproc, over)
+        try:
+            outs, codes = _run_workers(_free_port(), nproc, over)
+        except AssertionError as e:  # worker-group deadline in _run_workers
+            last_err = e
+            continue
+        last_result = (outs, codes)
         transient = any(
             "ddress already in use" in o or "DEADLINE_EXCEEDED" in o
             for o in outs
         )
         if not transient:
             return outs, codes
-    return outs, codes
+    if last_result is not None:  # completed attempts beat stale timeouts
+        return last_result
+    raise last_err
 
 
 def test_four_process_model_axis_spans_processes():
