@@ -1,0 +1,204 @@
+"""Attribute a segmentation checkpoint's residual IoU gap, voxel by voxel.
+
+The order-ambiguity ceiling is measured model-free by ``data.seg_oracle``;
+after the canonical-label fix removed that ambiguity, the remaining gap (IoU
+~0.81 vs ~1.0) needs attribution: is it *class identity* confusion inside
+geometric families (a rectangular through step is a voxel-subset of a
+two-sided through step — deciding which class a side-carve belongs to takes
+global reasoning about the opposite face), or *detection* failure (feature
+voxels called stock / wrong shapes)?
+
+This tool runs one exact held-out pass with the trained checkpoint and
+reports:
+
+- the voxel-level confusion matrix over the 25 labels (stock + 24 classes);
+- the top confused class pairs, and the *families* they induce (connected
+  components of the pair graph above a confusion threshold);
+- mean IoU as trained, and mean IoU with each family collapsed to one
+  label, for prediction AND truth. The delta is the measured cost of class
+  identity inside families; the collapsed number is what a
+  family-level recognizer already achieves.
+
+Run:  python -m featurenet_tpu.train.seg_diagnose
+          --checkpoint-dir CK --data-cache CACHE [--threshold 0.1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def _families(conf: np.ndarray, threshold: float) -> list[list[int]]:
+    """Connected components of the symmetrized row-normalized confusion
+    graph over feature classes (label 0 = stock excluded): classes i,j are
+    linked when either direction's confusion rate exceeds ``threshold``."""
+    n = conf.shape[0]
+    row = conf.sum(axis=1, keepdims=True)
+    rate = conf / np.maximum(row, 1)
+    adj = np.zeros((n, n), bool)
+    for i in range(1, n):
+        for j in range(1, n):
+            if i != j and (rate[i, j] > threshold or rate[j, i] > threshold):
+                adj[i, j] = adj[j, i] = True
+    seen, out = set(), []
+    for i in range(1, n):
+        if i in seen:
+            continue
+        comp, stack = [], [i]
+        seen.add(i)
+        while stack:
+            u = stack.pop()
+            comp.append(u)
+            for v in np.nonzero(adj[u])[0]:
+                if v not in seen:
+                    seen.add(int(v))
+                    stack.append(int(v))
+        if len(comp) > 1:
+            out.append(sorted(comp))
+    return out
+
+
+def _mean_iou_from_confusion(conf: np.ndarray) -> tuple[float, np.ndarray]:
+    """Exact per-class IoU from a voxel confusion matrix: inter = diagonal,
+    union = row + col - diagonal. Same aggregation as train.steps."""
+    inter = np.diag(conf).astype(np.float64)
+    union = conf.sum(1) + conf.sum(0) - inter
+    present = union > 0
+    iou = np.where(present, inter / np.maximum(union, 1), 0.0)
+    return float(iou.sum() / max(int(present.sum()), 1)), iou
+
+
+def _collapse(conf: np.ndarray, families: list[list[int]]) -> np.ndarray:
+    """Merge each family's rows+cols into one label.
+
+    Mapping-based, not positional deletion: every label maps to its
+    family's representative up front, then the matrix is aggregated in one
+    pass — no index shifting between families (a positional scheme merged
+    the *wrong* classes for the second family onward; caught in review,
+    covered by the two-family unit test).
+    """
+    n = conf.shape[0]
+    mapping = np.arange(n)
+    for fam in families:
+        mapping[fam] = fam[0]
+    _, inv = np.unique(mapping, return_inverse=True)
+    m = int(inv.max()) + 1
+    flat = inv[:, None] * m + inv[None, :]
+    return (
+        np.bincount(flat.ravel(), weights=conf.ravel(), minlength=m * m)
+        .reshape(m, m)
+        .astype(conf.dtype)
+    )
+
+
+def diagnose(
+    checkpoint_dir: str,
+    data_cache: str,
+    threshold: float = 0.1,
+    batch: int = 32,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.data.offline import SegCacheDataset
+    from featurenet_tpu.data.synthetic import CLASS_NAMES
+    from featurenet_tpu.train.checkpoint import (
+        CheckpointManager,
+        load_run_config,
+    )
+    from featurenet_tpu.train.loop import build_model
+    from featurenet_tpu.train.state import create_state
+    from featurenet_tpu.train.steps import make_optimizer, unpack_voxels
+
+    cfg = load_run_config(checkpoint_dir)
+    if cfg is None or cfg.task != "segment":
+        raise SystemExit(
+            "seg_diagnose needs a segment checkpoint with a persisted "
+            f"config (got {getattr(cfg, 'task', None)!r})"
+        )
+    model = build_model(cfg)  # exactly the trained module tree
+    R = cfg.resolution
+    dummy = jnp.zeros((batch, R, R, R, 1), jnp.float32)
+    state = create_state(model, make_optimizer(cfg), dummy, jax.random.key(0))
+    state = CheckpointManager(checkpoint_dir, config=cfg).restore(state)
+
+    @jax.jit
+    def predict(params, batch_stats, packed):
+        x = unpack_voxels(packed)  # [B,R,R,R,1] float32
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False
+        )
+        return jnp.argmax(logits, axis=-1).astype(jnp.int8)
+
+    ds = SegCacheDataset(
+        data_cache, global_batch=batch, split="test",
+        test_fraction=cfg.test_fraction,
+    )
+    n_cls = cfg.arch.num_classes + 1
+    conf = np.zeros((n_cls, n_cls), np.int64)
+    for b in ds.epoch_batches(batch):
+        pred = np.asarray(
+            predict(state.params, state.batch_stats, jnp.asarray(b["voxels"]))
+        )
+        valid = b["mask"] > 0
+        t = b["seg"][valid].ravel().astype(np.int64)
+        p = pred[valid].ravel().astype(np.int64)
+        # bincount over t*n+p, not np.add.at: ~10^8 scatter updates per
+        # pass through ufunc.at is minutes; bincount is seconds.
+        conf += np.bincount(
+            t * n_cls + p, minlength=n_cls * n_cls
+        ).reshape(n_cls, n_cls)
+
+    raw_miou, raw_iou = _mean_iou_from_confusion(conf)
+    fams = _families(conf, threshold)
+    collapsed_miou, _ = _mean_iou_from_confusion(_collapse(conf, fams))
+
+    def name(i):  # label 0 is stock/air
+        return "stock" if i == 0 else CLASS_NAMES[i - 1]
+
+    row = conf.sum(1)
+    top_pairs = sorted(
+        (
+            (float(conf[i, j] / max(row[i], 1)), name(i), name(j))
+            for i in range(1, n_cls)
+            for j in range(n_cls)
+            if i != j and conf[i, j] > 0
+        ),
+        reverse=True,
+    )[:8]
+    return {
+        "checkpoint": checkpoint_dir,
+        "mean_iou": round(raw_miou, 4),
+        "mean_iou_family_collapsed": round(collapsed_miou, 4),
+        "family_identity_cost": round(collapsed_miou - raw_miou, 4),
+        "families": [[name(c) for c in fam] for fam in fams],
+        "confusion_threshold": threshold,
+        "top_confused_pairs": [
+            {"rate": round(r, 3), "true": t, "pred": p}
+            for r, t, p in top_pairs
+        ],
+        "per_class_iou": {
+            name(i): round(float(v), 4) for i, v in enumerate(raw_iou)
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--data-cache", required=True)
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="row-normalized confusion rate above which two "
+                         "classes are joined into a family")
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+    print(json.dumps(diagnose(
+        args.checkpoint_dir, args.data_cache, args.threshold, args.batch
+    )))
+
+
+if __name__ == "__main__":
+    main()

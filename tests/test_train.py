@@ -197,3 +197,51 @@ def test_measure_train_step_rejects_segment_config():
 
     with pytest.raises(ValueError, match="classify"):
         measure_train_step(get_config("seg64"))
+
+
+def test_seg_diagnose_confusion_math():
+    """Family detection, collapse, and IoU-from-confusion on a hand-built
+    voxel confusion matrix (4 labels: stock + 3 classes; classes 2 and 3
+    confuse both ways above threshold, class 1 is clean)."""
+    import numpy as np
+
+    from featurenet_tpu.train.seg_diagnose import (
+        _collapse,
+        _families,
+        _mean_iou_from_confusion,
+    )
+
+    conf = np.array([
+        [100, 0, 0, 0],
+        [0, 50, 0, 0],
+        [0, 0, 40, 10],   # 20% of true-2 predicted 3
+        [0, 0, 15, 35],   # 30% of true-3 predicted 2
+    ], dtype=np.int64)
+    fams = _families(conf, threshold=0.1)
+    assert fams == [[2, 3]]
+    miou, iou = _mean_iou_from_confusion(conf)
+    # class 2: inter 40, union 50+55-40=65; class 3: 35 / (50+45-35)=60
+    np.testing.assert_allclose(iou[2], 40 / 65)
+    np.testing.assert_allclose(iou[3], 35 / 60)
+    collapsed = _collapse(conf, fams)
+    assert collapsed.shape == (3, 3)
+    m2, iou2 = _mean_iou_from_confusion(collapsed)
+    np.testing.assert_allclose(iou2[-1], 1.0)  # merged family is exact
+    assert m2 > miou
+    # Classes below threshold stay separate.
+    assert _families(conf, threshold=0.5) == []
+    # Two disjoint families: the mapping-based collapse must merge each
+    # family's own members (the positional-deletion scheme it replaced
+    # merged the wrong classes for every family after the first).
+    conf2 = np.zeros((6, 6), np.int64)
+    np.fill_diagonal(conf2, 50)
+    conf2[1, 2] = 20
+    conf2[2, 1] = 15
+    conf2[4, 5] = 20
+    conf2[5, 4] = 25
+    fams2 = _families(conf2, threshold=0.1)
+    assert fams2 == [[1, 2], [4, 5]]
+    out2 = _collapse(conf2, fams2)
+    assert out2.shape == (4, 4)
+    _, iou_all = _mean_iou_from_confusion(out2)
+    np.testing.assert_allclose(iou_all, 1.0)
