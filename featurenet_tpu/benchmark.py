@@ -257,6 +257,14 @@ def measure_inference(
         int(labels[0])  # device→host readback = honest sync
         return time.perf_counter() - t0
 
+    # Adaptive slope length: a fast forward (warp64 is ~2 ms/batch) over
+    # only MEASURE iterations gives a ~40 ms window that drowns in
+    # tunnel/readback jitter (observed 159% spread). Size the window to
+    # ~1 s of device work so the slope dominates the noise; best-of-2
+    # probes so one jitter spike can't shrink the window back into the
+    # noisy regime this sizing exists to escape.
+    probe = max(min(walled(measure), walled(measure)) / measure, 1e-6)
+    measure = max(measure, int(1.0 / probe))
     per_batch, spread_pct = _best_slope(walled, measure, repeats)
     return {
         "batch_per_chip": batch_per_chip,

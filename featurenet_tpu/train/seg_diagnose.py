@@ -137,7 +137,13 @@ def diagnose(
         data_cache, global_batch=batch, split="test",
         test_fraction=cfg.test_fraction,
     )
-    n_cls = cfg.arch.num_classes + 1
+    # Class count from the model's own output head (build_model doesn't
+    # thread arch.num_classes into the segmenter, so the config value can
+    # diverge from what the checkpoint actually predicts).
+    n_cls = model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        dummy[:1], train=False,
+    ).shape[-1]
     conf = np.zeros((n_cls, n_cls), np.int64)
     for b in ds.epoch_batches(batch):
         pred = np.asarray(
