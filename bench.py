@@ -25,9 +25,16 @@ import json
 
 from featurenet_tpu.benchmark import (
     V100_SAMPLES_PER_SEC_EST,
+    measure_e2e,
     measure_inference,
     measure_train_step,
 )
+
+# The 24x1000 64^3 packed cache (built by `cli export-data`/`build-cache`);
+# when present, bench.py also reports END-TO-END wall-clock training rate
+# (host feed -> dispatch -> readback) unpipelined vs k-step pipelined.
+E2E_CACHE = ".data/cls64_cache"
+E2E_K = 8
 
 # Independent slope measurements per model: the headline is the best slope,
 # the artifact carries the spread. One slope through this environment's
@@ -39,8 +46,18 @@ REPEATS = 5
 
 def main() -> None:
     import os
+    import time
 
     from featurenet_tpu.config import get_config
+
+    # Bounded idle-wait: a loaded host contaminates slope timings (round-3
+    # profiler shipped a 10x bad reading under contention). Wait up to 2
+    # minutes for the 1-minute loadavg to drop before measuring; record
+    # both loadavgs in-artifact either way.
+    load_at_invoke = float(os.getloadavg()[0])
+    deadline = time.monotonic() + 120.0
+    while os.getloadavg()[0] > 0.9 and time.monotonic() < deadline:
+        time.sleep(5.0)
 
     # Flagship = warp64 (round 3): turbo64's 7³ stem strided by 4 (s2d),
     # producing 16³ directly instead of 32³-then-pool — the profiler
@@ -55,6 +72,35 @@ def main() -> None:
     )
     paper = measure_train_step(get_config("pod64"), repeats=REPEATS)
     serving = measure_inference(cfg, repeats=REPEATS)
+    e2e = {}
+    if os.path.isdir(E2E_CACHE):
+        kw = dict(data_cache=E2E_CACHE, data_workers=1,
+                  checkpoint_dir=None, heartbeat_file=None)
+        plain = measure_e2e(get_config("warp64", **kw))
+        piped = measure_e2e(
+            get_config("warp64", steps_per_dispatch=E2E_K, **kw)
+        )
+        hbm = measure_e2e(
+            get_config("warp64", hbm_cache=True,
+                       steps_per_dispatch=E2E_K, **kw),
+            steps=96,
+        )
+        e2e = {
+            "e2e_samples_per_sec": plain["e2e_samples_per_sec"],
+            "e2e_pipelined_samples_per_sec": piped["e2e_samples_per_sec"],
+            "e2e_hbm_samples_per_sec": hbm["e2e_samples_per_sec"],
+            "e2e_steps_per_dispatch": E2E_K,
+            "e2e_pipeline_speedup": round(
+                piped["e2e_samples_per_sec"]
+                / max(plain["e2e_samples_per_sec"], 1e-9), 2
+            ),
+            # Device-resident dataset + fused dispatch vs the unpipelined
+            # host-streamed loop — the round-4 wall-clock headline.
+            "e2e_hbm_speedup": round(
+                hbm["e2e_samples_per_sec"]
+                / max(plain["e2e_samples_per_sec"], 1e-9), 2
+            ),
+        }
     print(json.dumps({
         "metric": "featurenet64_train_throughput",
         "value": flag["samples_per_sec_per_chip"],
@@ -67,19 +113,25 @@ def main() -> None:
         "repeats": flag["repeats"],
         "spread_pct": flag["spread_pct"],
         "load_avg_1m": float(os.getloadavg()[0]),
+        "load_avg_1m_at_invoke": round(load_at_invoke, 2),
         "gflops_per_sample": flag["gflops_per_sample"],
         "tflops_per_sec_per_chip": flag["tflops_per_sec_per_chip"],
         "mfu": flag["mfu"],
         "mfu_peak_tflops": flag["mfu_peak_tflops"],
         "serving_inferences_per_sec_per_chip":
             serving["inferences_per_sec_per_chip"],
+        # Best-two-slope agreement after convergence (see measure_inference);
+        # serving_spread_minmax_pct is the full draw range incl. outliers.
         "serving_spread_pct": serving["spread_pct"],
+        "serving_spread_minmax_pct": serving["spread_minmax_pct"],
+        "serving_repeats": serving["repeats"],
         "paper_arch_sps_per_chip": paper["samples_per_sec_per_chip"],
         "paper_arch_vs_baseline": round(
             paper["samples_per_sec_per_chip"] / V100_SAMPLES_PER_SEC_EST, 3
         ),
         "paper_arch_mfu": paper["mfu"],
         "paper_arch_spread_pct": paper["spread_pct"],
+        **e2e,
     }))
 
 

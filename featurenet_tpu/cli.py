@@ -49,6 +49,15 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="backend for stride-1 conv blocks (default xla)")
     p.add_argument("--seg-loss", choices=["balanced_ce", "ce_dice", "dice"],
                    help="segmentation loss variant (default balanced_ce)")
+    p.add_argument("--hbm-cache", action="store_true", dest="hbm_cache",
+                   help="upload the packed train split into device HBM "
+                        "once and sample batches on device (classify + "
+                        "--data-cache only; zero per-step input traffic)")
+    p.add_argument("--steps-per-dispatch", type=int,
+                   dest="steps_per_dispatch",
+                   help="fuse k train steps into one compiled dispatch "
+                        "(amortizes host/link latency; numerically "
+                        "equivalent to k single steps)")
     p.add_argument("--restart-every", type=int, dest="restart_every_steps",
                    help="supervised runs: checkpoint + respawn a fresh "
                         "process every N steps (clears the tunnel client's "
@@ -86,7 +95,7 @@ def _overrides(args) -> dict:
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
-        "restart_every_steps",
+        "restart_every_steps", "steps_per_dispatch",
     ]
     out = {
         k: getattr(args, k, None)
@@ -95,6 +104,8 @@ def _overrides(args) -> dict:
     }
     if getattr(args, "no_augment", False):
         out["augment"] = False
+    if getattr(args, "hbm_cache", False):
+        out["hbm_cache"] = True
     return out
 
 

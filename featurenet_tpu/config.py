@@ -81,6 +81,23 @@ class Config:
     # — a fresh process restores full speed and the Orbax resume makes the
     # handoff exact.
     restart_every_steps: Optional[int] = None
+    # Device-resident dataset: upload the packed train split into HBM once
+    # (sharded over the mesh's data axis) and draw every train batch ON
+    # DEVICE (train.steps.make_hbm_multi_train_step) — zero per-step
+    # host→device input traffic. The natural fit for this benchmark's
+    # scale: the 24×1000 64³ split bit-packed is ~600 MB against 16 GB of
+    # v5e HBM. Classify + data_cache only; incompatible with spatial
+    # sharding (the resident array shards batch rows, not depth).
+    hbm_cache: bool = False
+    # Pipelined dispatch: fuse this many train steps into one XLA
+    # executable (train.steps.make_multi_train_step), so one host→device
+    # dispatch carries k optimizer updates. Amortizes per-step dispatch
+    # latency on slow hosts/links (this environment's tunnel charges
+    # ~11 ms/call — BASELINE.md round 3); numerics match k sequential
+    # single-step dispatches to one-ulp (XLA fusion reassociation only).
+    # Logging/eval/checkpoint cadences keep their step semantics but fire
+    # on dispatch boundaries.
+    steps_per_dispatch: int = 1
     # Backpressure: max train steps dispatched ahead of confirmed execution.
     # Async dispatch with no bound pins every in-flight batch in memory; on
     # backends where block_until_ready is unreliable (this environment's
@@ -140,6 +157,35 @@ class Config:
                     "silently ignoring the flag would leave the RSS-leak "
                     "mitigation off"
                 )
+        if self.hbm_cache:
+            if self.task != "classify":
+                raise ValueError("hbm_cache supports task='classify' only")
+            if self.spatial:
+                raise ValueError(
+                    "hbm_cache is incompatible with spatial sharding: the "
+                    "resident dataset shards batch rows over 'data', not "
+                    "depth over 'model'"
+                )
+            if not self.data_cache:
+                raise ValueError(
+                    "hbm_cache requires data_cache (the split that gets "
+                    "uploaded is the offline cache's train split)"
+                )
+            if self.augment and not (
+                self.augment_device and self.augment_groups > 0
+            ):
+                raise ValueError(
+                    "hbm_cache with augment=True requires device "
+                    "augmentation (augment_device=True, augment_groups>=1):"
+                    " the resident dataset has no host-side augmentation "
+                    "path, so augment=True would otherwise be silently "
+                    "ignored — pass augment=False to train unaugmented"
+                )
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got "
+                f"{self.steps_per_dispatch}"
+            )
         if self.augment and self.augment_device and self.augment_groups < 1:
             raise ValueError(
                 "augment_groups must be >= 1 when device augmentation is "

@@ -132,6 +132,20 @@ def _local_block(sharding, local_shape: tuple) -> tuple:
             for s in mine
         ]
         lo, hi = min(starts), max(stops)
+        # The [lo, hi) bounding box is only a valid block if the addressable
+        # slices tile it densely: a sharding whose local slices were
+        # non-contiguous in this dim would otherwise feed a wrong block with
+        # only an indirect downstream failure.
+        ivals = sorted(set(zip(starts, stops)))
+        cursor = lo
+        for s0, s1 in ivals:
+            if s0 > cursor:
+                raise ValueError(
+                    f"addressable shards are non-contiguous in dim {dim}: "
+                    f"gap [{cursor}, {s0}) inside block [{lo}, {hi}); "
+                    "put_batch requires a dense local block per dim"
+                )
+            cursor = max(cursor, s1)
         if dim == 0:
             # Rows: the feed is exactly this block; keep feed-relative.
             if hi - lo != local_shape[0]:

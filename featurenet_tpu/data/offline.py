@@ -793,6 +793,36 @@ class VoxelCacheDataset:
     def __len__(self) -> int:
         return len(self.labels)
 
+    def materialize_split(
+        self, multiple_of: int = 1, num_shards: int = 1, shard_id: int = 0
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """This host's block of the DEVICE-RESIDENT (HBM) dataset.
+
+        Returns ``(packed_voxels, labels, n_global)``: the rows of a
+        seed-shuffled global order that fall in feed-group ``shard_id``'s
+        contiguous block. The global order is trimmed to a multiple of
+        ``multiple_of`` (the mesh's data-axis size — shard_map needs even
+        dim-0 shards; at most ``multiple_of - 1`` rows are dropped, and
+        which rows is seed-deterministic). The shuffle is what makes each
+        device's block a random subset, so the on-device block-stratified
+        draw (train.steps.make_hbm_multi_train_step) samples the whole
+        class distribution from every shard.
+        """
+        n = len(self.labels)
+        keep = n - (n % max(multiple_of, 1))
+        if keep < num_shards:
+            raise ValueError(
+                f"split has {n} rows; {keep} after trimming to a multiple "
+                f"of {multiple_of} — too few for {num_shards} feed groups"
+            )
+        order = np.random.default_rng(
+            np.random.SeedSequence([self.seed, 0x4B10C5])
+        ).permutation(n)[:keep]
+        lo = keep * shard_id // num_shards
+        hi = keep * (shard_id + 1) // num_shards
+        rows = order[lo:hi]
+        return self._gather(rows), self.labels[rows], keep
+
     def worker_iter(
         self, worker_id: int = 0, num_workers: int = 1
     ) -> Iterator[dict[str, np.ndarray]]:

@@ -53,22 +53,45 @@ HBM_GBPS = 819.0
 RIDGE_FLOP_PER_BYTE = PEAK_BF16_TFLOPS * 1e12 / (HBM_GBPS * 1e9)  # ~240
 
 
-def _slope_time(fn, args, iters: int = 12, repeats: int = 3) -> float:
-    """Per-call seconds of a jitted scalar-returning fn via slope timing."""
+def _slope_time(
+    fn, args, iters: int = 12, repeats: int = 3
+) -> tuple[float, float]:
+    """Per-call seconds of a jitted scalar-returning fn via slope timing.
+
+    Returns ``(best, spread_pct)``: the best of ``repeats`` independent
+    slopes and their (max-min)/best spread. A contaminated reading (host
+    contention, tunnel stall) shows up as a large spread instead of
+    silently poisoning a published table — the round-3 turbo64 head line
+    shipped a 10x contaminated value precisely because the old API
+    returned one anonymous float (BASELINE.md round-3 profiler note)."""
     float(fn(*args))  # compile + warm
 
     def wall(k: int) -> float:
-        best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(k):
-                out = fn(*args)
-            float(out)
-            best = min(best, time.perf_counter() - t0)
-        return best
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = fn(*args)
+        float(out)
+        return time.perf_counter() - t0
 
-    return (wall(1 + iters) - wall(1)) / iters
+    slopes = [
+        (wall(1 + iters) - wall(1)) / iters for _ in range(repeats)
+    ]
+    best = min(slopes)
+    spread = 100.0 * (max(slopes) - best) / best if best > 0 else 0.0
+    return best, spread
+
+
+def _delta_spread(a: float, sp_a: float, b: float, sp_b: float) -> float:
+    """Propagated spread of the difference ``a - b`` (percent).
+
+    A delta of two independently noisy slopes carries the *absolute* noise
+    of both over a (possibly much smaller) difference — a per-block delta
+    can be 100%+ uncertain while each prefix shows single-digit spread, so
+    tagging the delta with one input's spread would understate it (the
+    round-3 contaminated head reading hid exactly this way)."""
+    err = abs(a) * sp_a / 100.0 + abs(b) * sp_b / 100.0
+    return 100.0 * err / max(abs(a - b), 1e-9)
 
 
 @dataclasses.dataclass
@@ -147,8 +170,10 @@ def main() -> None:
     voxels = jnp.asarray(rng.random((B, R, R, R, 1)) < 0.5, jnp.float32)
     rows = []
 
-    def record(name, sec, flops=None, extra=None):
+    def record(name, sec, flops=None, extra=None, spread=None):
         row = {"metric": name, "value": round(sec * 1e3, 3), "unit": "ms"}
+        if spread is not None:
+            row["spread_pct"] = round(spread, 1)
         if flops:
             row["tflops"] = round(flops / sec / 1e12, 1)
         if extra:
@@ -156,13 +181,26 @@ def main() -> None:
         rows.append(row)
         print(json.dumps(row))
 
-    print(json.dumps({
+    # Session noise header: lever decisions ride on these tables, so the
+    # table must describe its own measurement conditions (bench.py policy).
+    import os
+
+    load1 = os.getloadavg()[0]
+    header = {
         "preset": cfg.name, "batch": B, "resolution": R,
+        "load_avg_1m": round(load1, 2),
         "arch": {
             "features": list(a.features), "kernels": list(a.kernels),
             "strides": list(a.strides), "pool_after": list(a.pool_after),
         },
-    }))
+    }
+    if load1 > 0.8:
+        header["load_warning"] = (
+            f"1m loadavg {load1:.2f} on this host: timings may be "
+            "contaminated by host contention; prefer an idle host or "
+            "distrust rows with large spread_pct"
+        )
+    print(json.dumps(header))
 
     # --- roofline table (static analysis, no device) ------------------------
     for b in blocks:
@@ -231,6 +269,7 @@ def main() -> None:
         return fb, params
 
     prev_f, prev_fb = 0.0, 0.0
+    prev_sp_f = prev_sp_fb = 0.0
     flops_prefix = 0.0
     for k in range(1, len(a.features) + 1):
         flops_prefix += blocks[k - 1].flops * B
@@ -241,20 +280,22 @@ def main() -> None:
         def fwd_sum(vs, x, _m=model_k):
             return jnp.sum(_m.apply(vs, x, train=False)).astype(jnp.float32)
 
-        t = _slope_time(fwd_sum, (vs, voxels))
-        record(f"fwd_prefix_{k}blocks", t, flops_prefix)
-        record(f"fwd_block_{k}_delta", t - prev_f)
-        prev_f = t
+        t, sp = _slope_time(fwd_sum, (vs, voxels))
+        record(f"fwd_prefix_{k}blocks", t, flops_prefix, spread=sp)
+        record(f"fwd_block_{k}_delta", t - prev_f,
+               spread=_delta_spread(t, sp, prev_f, prev_sp_f))
+        prev_f, prev_sp_f = t, sp
 
         # fwd+bwd through the same prefix: grad of sum w.r.t. params. Eval-
         # mode BN (running stats) so no mutable collection threads through
         # grad; the conv/BN-scale backward cost — the expensive part — is
         # identical in train mode.
         fb, params_k = grad_sum_fn(model_k, vs)
-        t2 = _slope_time(fb, (params_k, voxels))
-        record(f"fwdbwd_prefix_{k}blocks", t2, 3 * flops_prefix)
-        record(f"fwdbwd_block_{k}_delta", t2 - prev_fb)
-        prev_fb = t2
+        t2, sp2 = _slope_time(fb, (params_k, voxels))
+        record(f"fwdbwd_prefix_{k}blocks", t2, 3 * flops_prefix, spread=sp2)
+        record(f"fwdbwd_block_{k}_delta", t2 - prev_fb,
+               spread=_delta_spread(t2, sp2, prev_fb, prev_sp_fb))
+        prev_fb, prev_sp_fb = t2, sp2
     tower_fb_total = prev_fb
 
     # --- (c) isolated blocks at real shapes, with conv dx/dw drill-down -----
@@ -274,12 +315,13 @@ def main() -> None:
                 _b.apply({"params": p, **_rest}, x, train=False)
             ).astype(jnp.float32)
 
-        t_f = _slope_time(blk_fwd, (params_b, x_in))
-        record(f"iso_block_{b.index}_fwd", t_f, b.flops * B)
+        t_f, sp_f = _slope_time(blk_fwd, (params_b, x_in))
+        record(f"iso_block_{b.index}_fwd", t_f, b.flops * B, spread=sp_f)
 
         fb_b, _ = grad_sum_fn(blk, vs)
-        t_fb = _slope_time(fb_b, (params_b, x_in))
-        record(f"iso_block_{b.index}_fwdbwd", t_fb, 3 * b.flops * B)
+        t_fb, sp_fb = _slope_time(fb_b, (params_b, x_in))
+        record(f"iso_block_{b.index}_fwdbwd", t_fb, 3 * b.flops * B,
+               spread=sp_fb)
 
         # Conv-only dx / dw (the MXU contractions, no BN/relu): where the
         # round-2 analysis found the 25%-of-peak dW shape ceiling.
@@ -306,10 +348,12 @@ def main() -> None:
                 lambda acc, y: acc + jnp.sum(y).astype(jnp.float32), g, 0.0
             )
 
-        record(f"iso_block_{b.index}_conv_dx",
-               _slope_time(conv_dx, (cvars, x_in)), b.flops * B)
-        record(f"iso_block_{b.index}_conv_dw",
-               _slope_time(conv_dw, (cvars, x_in)), b.flops * B)
+        t_dx, sp_dx = _slope_time(conv_dx, (cvars, x_in))
+        record(f"iso_block_{b.index}_conv_dx", t_dx, b.flops * B,
+               spread=sp_dx)
+        t_dw, sp_dw = _slope_time(conv_dw, (cvars, x_in))
+        record(f"iso_block_{b.index}_conv_dw", t_dw, b.flops * B,
+               spread=sp_dw)
 
     # --- (d) head isolated, then full model ---------------------------------
     last = blocks[-1]
@@ -344,8 +388,8 @@ def main() -> None:
     d1_in = last.cout if a.head_gap else s_head**3 * last.cout
     head_flops = 2 * B * (d1_in * a.hidden + a.hidden * a.num_classes)
     head_fb, hparams = grad_sum_fn(head, hvars)
-    t_head = _slope_time(head_fb, (hparams, head_in))
-    record("head_fwdbwd", t_head, 3 * head_flops)
+    t_head, sp_head = _slope_time(head_fb, (hparams, head_in))
+    record("head_fwdbwd", t_head, 3 * head_flops, spread=sp_head)
 
     # --- full forward vs fwd+bwd --------------------------------------------
     model = FeatureNet(arch=a)
@@ -370,11 +414,11 @@ def main() -> None:
             logits, lab
         ).mean(), new_vars
 
-    t_fwd = _slope_time(
+    t_fwd, sp_fwd = _slope_time(
         jax.jit(lambda p, bs, v, l: loss_fn(p, bs, v, l)[0]),
         (params, batch_stats, voxels, labels),
     )
-    record("full_fwd_train", t_fwd)
+    record("full_fwd_train", t_fwd, spread=sp_fwd)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
@@ -385,9 +429,10 @@ def main() -> None:
             lambda x, y: x + jnp.sum(y).astype(jnp.float32), grads, 0.0
         )
 
-    t_fb = _slope_time(fwdbwd, (params, batch_stats, voxels, labels))
-    record("full_fwd_bwd", t_fb)
-    record("bwd_delta", t_fb - t_fwd)
+    t_fb, sp_fb = _slope_time(fwdbwd, (params, batch_stats, voxels, labels))
+    record("full_fwd_bwd", t_fb, spread=sp_fb)
+    record("bwd_delta", t_fb - t_fwd,
+           spread=_delta_spread(t_fb, sp_fb, t_fwd, sp_fwd))
 
     # --- complete train step (unpack+augment+opt included) ------------------
     tx = make_optimizer(cfg)
@@ -400,15 +445,18 @@ def main() -> None:
 
     state, m = step(state, batch, key)  # compile
     float(m["loss"])
-    best = float("inf")
+    walls = []
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(10):
             state, m = step(state, batch, key)
         float(m["loss"])
-        best = min(best, (time.perf_counter() - t0) / 10)
-    record("train_step_total_incl_dispatch", best)
-    record("overhead_opt_unpack_aug_dispatch", best - t_fb)
+        walls.append((time.perf_counter() - t0) / 10)
+    best = min(walls)
+    sp_step = 100.0 * (max(walls) - best) / best if best > 0 else 0.0
+    record("train_step_total_incl_dispatch", best, spread=sp_step)
+    record("overhead_opt_unpack_aug_dispatch", best - t_fb,
+           spread=_delta_spread(best, sp_step, t_fb, sp_fb))
 
     # --- attribution check: how much of fwd+bwd do the parts explain? -------
     attributed = tower_fb_total + t_head
@@ -419,8 +467,13 @@ def main() -> None:
             "sum_parts_ms": round(attributed * 1e3, 2),
             "full_fwdbwd_ms": round(t_fb * 1e3, 2),
             "attributed_pct": round(100 * attributed / t_fb, 1),
-            "note": "parts exclude the loss/softmax and cross-prefix XLA "
-                    "fusion differences; >=90% closes the verdict ask",
+            "note": "parts are measured in eval mode (running-stats BN, "
+                    "dropout inactive) while the full_fwd_bwd denominator "
+                    "runs train mode — its batch-stat computation and "
+                    "dropout cost are structurally unattributable here, on "
+                    "top of loss/softmax and cross-prefix XLA fusion "
+                    "differences; >=90% closes the verdict ask",
+            "load_avg_1m_end": round(os.getloadavg()[0], 2),
         }
     }))
     print(json.dumps({"summary": rows}))

@@ -76,6 +76,13 @@ def feed_shards(mesh: Mesh) -> tuple[int, int]:
         if any(d.process_index == p for d in grid[r].flat)
     ]
     k = len(rows)
+    if not rows:
+        raise ValueError(
+            f"process {p} owns no devices in this mesh (shape "
+            f"{dict(zip(mesh.axis_names, grid.shape))}); a feeding process "
+            "must appear in the mesh — pass this process's devices to "
+            "make_mesh or exclude it from the data feed"
+        )
     if rows != list(range(rows[0], rows[0] + k)):
         raise ValueError(
             f"process {p}'s devices occupy non-contiguous data rows {rows}; "

@@ -43,6 +43,8 @@ from featurenet_tpu.train.state import TrainState, create_state, param_count
 from featurenet_tpu.train.steps import (
     aggregate_eval,
     make_eval_step,
+    make_hbm_multi_train_step,
+    make_multi_train_step,
     make_optimizer,
     make_train_step,
 )
@@ -124,17 +126,34 @@ class Trainer:
         # Cache-backed classification augments on device (rotations inside
         # the compiled step); the host dataset then skips its rotation pass.
         self._device_aug = cfg.device_augment
+        step_kw = dict(
+            label_smoothing=cfg.label_smoothing,
+            augment_groups=cfg.augment_groups if self._device_aug else 0,
+            packed=packed,
+            seg_loss=cfg.seg_loss,
+        )
         self._train_step = jax.jit(
-            make_train_step(
-                self.model, cfg.task, cfg.label_smoothing,
-                augment_groups=cfg.augment_groups if self._device_aug else 0,
-                packed=packed,
-                seg_loss=cfg.seg_loss,
-            ),
+            make_train_step(self.model, cfg.task, **step_kw),
             in_shardings=(self.state_sh, self.batch_sh, rep),
             out_shardings=(self.state_sh, rep),
             donate_argnums=(0,),
         )
+        # Pipelined dispatch: k steps fused into one executable; the host
+        # dispatches once per k optimizer updates (bitwise-identical math,
+        # see make_multi_train_step). The single-step jit above stays for
+        # segment remainders (total % k) and as the k=1 path.
+        self._k = max(1, cfg.steps_per_dispatch)
+        if self._k > 1:
+            self._multi_step = jax.jit(
+                make_multi_train_step(
+                    self.model, cfg.task, num_steps=self._k, **step_kw
+                ),
+                in_shardings=(
+                    self.state_sh, (self.batch_sh,) * self._k, rep
+                ),
+                out_shardings=(self.state_sh, rep),
+                donate_argnums=(0,),
+            )
         self._eval_step = jax.jit(
             make_eval_step(self.model, cfg.task, packed=packed),
             in_shardings=(
@@ -232,6 +251,61 @@ class Trainer:
                 task=cfg.task,
             )
 
+        # --- device-resident dataset (HBM) mode -----------------------------
+        # Upload the packed train split once, sharded P('data') along rows;
+        # train steps then draw batches on device (zero per-step input
+        # traffic — see make_hbm_multi_train_step). The host stream above
+        # still exists for eval's exact epoch passes.
+        self._hbm = bool(cfg.hbm_cache)
+        if self._hbm:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            blk_vox, blk_lab, n_keep = self.train_data.materialize_split(
+                multiple_of=self.mesh.shape["data"],
+                num_shards=n_hosts,
+                shard_id=host_id,
+            )
+            d_sh = NamedSharding(self.mesh, P("data"))
+            if jax.process_count() == 1:
+                self._hbm_data = jax.device_put(blk_vox, d_sh)
+                self._hbm_labels = jax.device_put(
+                    blk_lab.astype(np.int32), d_sh
+                )
+            else:
+                self._hbm_data = jax.make_array_from_process_local_data(
+                    d_sh, blk_vox
+                )
+                self._hbm_labels = jax.make_array_from_process_local_data(
+                    d_sh, blk_lab.astype(np.int32)
+                )
+
+            def _hbm_jit(n_steps: int):
+                return jax.jit(
+                    make_hbm_multi_train_step(
+                        self.model, self.mesh, cfg.global_batch, cfg.task,
+                        cfg.label_smoothing,
+                        augment_groups=(
+                            cfg.augment_groups if self._device_aug else 0
+                        ),
+                        num_steps=n_steps,
+                    ),
+                    in_shardings=(self.state_sh, d_sh, d_sh, rep),
+                    out_shardings=(self.state_sh, rep),
+                    donate_argnums=(0,),
+                )
+
+            self._hbm_step_k = _hbm_jit(self._k)
+            # Remainder dispatches (total % k, segment cuts) run one step.
+            self._hbm_step_1 = (
+                _hbm_jit(1) if self._k > 1 else self._hbm_step_k
+            )
+            self.logger.log(0, {
+                "hbm_resident_rows": float(n_keep),
+                "hbm_resident_mb": round(
+                    (blk_vox.nbytes * n_hosts) / 1e6, 1
+                ),
+            }, prefix="setup")
+
         self.ckpt: Optional[CheckpointManager] = None
         if cfg.checkpoint_dir:
             self.ckpt = CheckpointManager(
@@ -251,6 +325,33 @@ class Trainer:
             touch_heartbeat(self.cfg.heartbeat_file)
 
     # ------------------------------------------------------------------
+    def dispatch_group(self, stream, take: int):
+        """Dispatch ``take`` train steps as one compiled call and return the
+        (device-resident) metrics of the last step.
+
+        The single source of dispatch truth: the run loop and the e2e
+        benchmark (``benchmark.measure_e2e``) both go through here, so what
+        the benchmark times is by construction what training executes.
+        ``stream`` is the prefetched batch iterator (unused — may be None —
+        in HBM-resident mode); ``take`` must be ``self._k`` or 1 (the
+        remainder path).
+        """
+        if self._hbm:
+            fn = self._hbm_step_k if take == self._k else self._hbm_step_1
+            self.state, metrics = fn(
+                self.state, self._hbm_data, self._hbm_labels, self._step_rng
+            )
+        elif take > 1:
+            batches = tuple(next(stream) for _ in range(take))
+            self.state, metrics = self._multi_step(
+                self.state, batches, self._step_rng
+            )
+        else:
+            self.state, metrics = self._train_step(
+                self.state, next(stream), self._step_rng
+            )
+        return metrics
+
     def resume_if_available(self) -> int:
         if self.ckpt and self.ckpt.latest_step() is not None:
             self.state = self.ckpt.restore(self.state)
@@ -308,7 +409,7 @@ class Trainer:
         self.logger.log(start, {"params": self.params_n,
                                 "devices": len(self.mesh.devices.flat)},
                         prefix="setup")
-        stream = prefetch_to_device(
+        stream = None if self._hbm else prefetch_to_device(
             self.train_data,
             sharding=self.batch_sh,
             num_workers=cfg.data_workers,
@@ -319,38 +420,48 @@ class Trainer:
         # actually executes, and always closed before the loop exits.
         trace_start = max(cfg.profile_start, start) if cfg.profile_dir else -1
         trace_active = False
+        trace_done = False
         # Dispatch-depth bound: hold the metrics of the last K steps; reading
         # one scalar from step N-K before dispatching step N+1 guarantees at
         # most K steps (and their pinned host batches) are ever in flight.
         pending: collections.deque = collections.deque()
         try:
-            for step in range(start, stop):
-                if step == trace_start:
+            step = start
+            while step < stop:
+                if (trace_start >= 0 and step >= trace_start
+                        and not trace_active and not trace_done):
                     jax.profiler.start_trace(cfg.profile_dir)
                     trace_active = True
-                batch = next(stream)
-                self.state, metrics = self._train_step(
-                    self.state, batch, self._step_rng
-                )
+                # Dispatch k fused steps while a full group fits in the
+                # segment; the remainder (total % k, segment cuts) runs
+                # single steps — cadences keep exact step semantics.
+                take = self._k if self._k > 1 and step + self._k <= stop else 1
+                metrics = self.dispatch_group(stream, take)
+                new_step = step + take
                 pending.append(metrics["loss"])
-                if len(pending) > max(cfg.max_inflight_steps, 1):
+                if len(pending) > max(cfg.max_inflight_steps // take, 1):
                     float(pending.popleft())  # readback = proof of progress
                     self._heartbeat()
                 if trace_active and (
-                    step + 1 >= trace_start + cfg.profile_steps
-                    or step + 1 == total
+                    new_step >= trace_start + cfg.profile_steps
+                    or new_step == total
                 ):
                     jax.block_until_ready(metrics)
                     jax.profiler.stop_trace()
                     trace_active = False
-                self.logger.count_samples(cfg.global_batch)
-                if (step + 1) % cfg.log_every == 0 or step + 1 == total:
-                    last = self.logger.log(step + 1, metrics)
-                if (step + 1) % cfg.eval_every == 0 or step + 1 == total:
+                    trace_done = True
+
+                def crossed(every: int) -> bool:
+                    return (new_step // every) > (step // every)
+
+                self.logger.count_samples(cfg.global_batch * take)
+                if crossed(cfg.log_every) or new_step == total:
+                    last = self.logger.log(new_step, metrics)
+                if crossed(cfg.eval_every) or new_step == total:
                     ev = self.evaluate()
                     # The 24×24 confusion matrix stays out of the log stream.
                     self.logger.log(
-                        step + 1,
+                        new_step,
                         {k: v for k, v in ev.items() if k != "confusion"},
                         prefix="eval",
                     )
@@ -358,10 +469,11 @@ class Trainer:
                     # Don't charge eval wall time to the next train window.
                     self.logger.start_window()
                     self._heartbeat()
-                if self.ckpt and ((step + 1) % cfg.checkpoint_every == 0
-                                  or step + 1 == total):
+                if self.ckpt and (crossed(cfg.checkpoint_every)
+                                  or new_step == total):
                     self.ckpt.save(self.state)
                     self._heartbeat()
+                step = new_step
         finally:
             if trace_active:
                 # An exception mid-window must not lose the trace of the

@@ -173,6 +173,138 @@ def make_train_step(
     return train_step
 
 
+def make_multi_train_step(
+    model,
+    task: str = "classify",
+    label_smoothing: float = 0.0,
+    augment_groups: int = 0,
+    packed: bool = False,
+    seg_loss: str = "balanced_ce",
+    num_steps: int = 2,
+) -> Callable:
+    """``num_steps`` train steps fused into ONE XLA executable.
+
+    Takes ``(state, batches, rng)`` where ``batches`` is a tuple of
+    ``num_steps`` wire batches; runs the single-step function over them
+    sequentially inside one compiled program and returns the final state
+    plus the last step's metrics. One dispatch then costs one host→device
+    round trip for ``num_steps`` optimizer updates — the standard TPU idiom
+    for amortizing per-step dispatch latency on a slow host or link (the
+    warp64 profile's largest non-compute line was 11.2 ms of per-call
+    dispatch through this environment's tunnel, BASELINE.md round 3).
+
+    Numerics match ``num_steps`` sequential dispatches of
+    ``make_train_step`` to one-ulp: the body *is* that function, and its
+    per-step rng fold keys off ``state.step``, which advances per inner
+    step — the only divergence is XLA reassociating fused matmuls across
+    step boundaries (measured ≤1.5e-8 on Dense kernels; pinned by
+    tests/test_train.py::test_steps_per_dispatch_matches_single_step).
+    """
+    if num_steps < 1:
+        raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+    step = make_train_step(
+        model, task, label_smoothing,
+        augment_groups=augment_groups, packed=packed, seg_loss=seg_loss,
+    )
+
+    def multi_step(state: TrainState, batches, rng):
+        metrics = None
+        for b in batches:
+            state, metrics = step(state, b, rng)
+        return state, metrics
+
+    return multi_step
+
+
+def make_hbm_multi_train_step(
+    model,
+    mesh,
+    global_batch: int,
+    task: str = "classify",
+    label_smoothing: float = 0.0,
+    augment_groups: int = 0,
+    num_steps: int = 1,
+) -> Callable:
+    """Train steps that SAMPLE THEIR BATCHES FROM HBM — zero per-step host
+    traffic.
+
+    The 24×1000 64³ benchmark bit-packed is ~750 MB: it fits in a v5e
+    chip's 16 GB HBM outright, so the TPU-native input pipeline for this
+    dataset scale is *device residency* — upload the packed train split
+    once, then every train step draws its batch on device. Takes
+    ``(state, data, labels, rng)`` where ``data`` is uint8
+    ``[N, R, R, R/8]`` and ``labels`` int32 ``[N]``, both sharded
+    ``P('data')`` along dim 0 over the mesh. Each data-axis shard draws
+    its ``global_batch / data_axis`` rows uniformly from its own block via
+    ``shard_map`` (decorrelated per shard by ``axis_index``), so sampling
+    needs no cross-shard collective; materialize the array from a
+    seed-shuffled global order so blocks are random subsets (the draw is
+    then block-stratified uniform — statistically equivalent to the host
+    sampler for training purposes, not bit-identical to it).
+
+    ``num_steps`` inner steps run inside the one executable (same fusion
+    as ``make_multi_train_step``); with the dataset resident, one dispatch
+    carries ``num_steps`` updates and ~zero bytes of input, which is what
+    lets end-to-end wall-clock match the device rate even through a slow
+    host link (measured in BASELINE.md round 4).
+    """
+    if num_steps < 1:
+        raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+    if task != "classify":
+        raise ValueError("HBM-resident sampling supports classify only")
+    from jax.sharding import PartitionSpec as P
+
+    step = make_train_step(
+        model, task, label_smoothing,
+        augment_groups=augment_groups, packed=True,
+    )
+    data_axis = mesh.shape["data"]
+    if global_batch % data_axis:
+        raise ValueError(
+            f"global_batch {global_batch} must divide over data axis "
+            f"{data_axis}"
+        )
+    local_batch = global_batch // data_axis
+
+    def draw(key, data_local, labels_local):
+        # Per-shard decorrelation: each data-axis block draws with its own
+        # fold of the step key from its own [n_local] row range.
+        ax = jax.lax.axis_index("data")
+        idx = jax.random.randint(
+            jax.random.fold_in(key, ax),
+            (local_batch,), 0, data_local.shape[0],
+        )
+        return (
+            jnp.take(data_local, idx, axis=0),
+            jnp.take(labels_local, idx, axis=0),
+        )
+
+    shard_draw = jax.shard_map(
+        draw,
+        mesh=mesh,
+        in_specs=(P(), P("data"), P("data")),
+        out_specs=(P("data"), P("data")),
+        check_vma=False,
+    )
+
+    def multi_step(state: TrainState, data, labels, rng):
+        metrics = None
+        for _ in range(num_steps):
+            # state.step advances per inner step, so each draw key and each
+            # inner step's dropout/augment fold are distinct; the extra
+            # fold decorrelates the draw from the step's own rng uses.
+            dkey = jax.random.fold_in(
+                jax.random.fold_in(rng, state.step), 0x5A11
+            )
+            voxels, lab = shard_draw(dkey, data, labels)
+            state, metrics = step(
+                state, {"voxels": voxels, "label": lab}, rng
+            )
+        return state, metrics
+
+    return multi_step
+
+
 def make_eval_step(
     model, task: str = "classify", packed: bool = False
 ) -> Callable:

@@ -28,9 +28,10 @@ import json, os, sys
 pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 over = json.loads(sys.argv[4])
 want_eval = over.pop("_eval", False)
+ndev = over.pop("_devices", 2)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_num_cpu_devices", ndev)
 repo = os.environ["PYTHONPATH"].split(os.pathsep)[0]  # set by the test
 cache_dir = os.path.join(repo, ".cache", "jax_compile")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
@@ -41,7 +42,7 @@ jax.distributed.initialize(
     process_id=pid,
 )
 assert jax.process_count() == nproc, jax.process_count()
-assert len(jax.devices()) == 2 * nproc, jax.devices()
+assert len(jax.devices()) == ndev * nproc, jax.devices()
 
 from featurenet_tpu.config import get_config
 from featurenet_tpu.train.loop import Trainer
@@ -245,6 +246,35 @@ def test_four_process_model_axis_spans_processes():
                 continue
             assert f[k] == finals[0][k], (k, finals)
     assert finals[0]["loss"] > 0.0
+
+
+def test_four_process_eval_matches_single_process(tmp_path):
+    """Assembly correctness, not just cross-host consistency: the 4-process
+    spatial mesh's exact eval must reproduce a *single-process* 8-device run
+    of the same mesh shape on the same cache — a feed mis-assembly that is
+    globally consistent (every host sees the same wrongly-assembled batch)
+    passes the sync test above but fails this one. Eval runs at init params
+    (total_steps=0, same seed → identical init) over the deterministic
+    epoch pass, so any metric divergence is the feed, not training. Global
+    row order differs between shardings (decimated vs sequential epoch
+    walk), so masked-sum metrics match to reduction-order tolerance, while
+    the confusion total must match exactly."""
+    from featurenet_tpu.data.offline import export_synthetic_cache
+
+    cache = str(tmp_path / "cache")
+    export_synthetic_cache(cache, per_class=3, resolution=16)
+    over = {"global_batch": 8, "total_steps": 0, "mesh_model": 4,
+            "spatial": True, "data_cache": cache, "_eval": True}
+    outs4, codes4 = _retry_port(4, over)
+    assert codes4 == [0] * 4, (codes4, [o[-1500:] for o in outs4])
+    evals4 = _collect(outs4, "EVAL")
+    outs1, codes1 = _retry_port(1, {**over, "_devices": 8})
+    assert codes1 == [0], outs1[0][-1500:]
+    ev1 = _collect(outs1, "EVAL")[0]
+    for ev in evals4:
+        assert ev["n_evaluated"] == ev1["n_evaluated"], (ev, ev1)
+        assert abs(ev["accuracy"] - ev1["accuracy"]) < 1e-6, (ev, ev1)
+        assert abs(ev["loss"] - ev1["loss"]) < 1e-5, (ev, ev1)
 
 
 def test_multiprocess_checkpoint_resume_and_planned_restart(tmp_path):
