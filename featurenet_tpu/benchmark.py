@@ -164,19 +164,18 @@ def measure_host_feed(cfg, batches: int = 50, warmup: int = 5) -> dict:
     """
     if not cfg.data_cache:
         raise ValueError("measure_host_feed needs cfg.data_cache")
+    host_augment = cfg.augment and not cfg.device_augment
     if cfg.task == "segment":
         from featurenet_tpu.data.offline import SegCacheDataset
 
         ds = SegCacheDataset(
             cfg.data_cache, global_batch=cfg.global_batch, split="train",
             test_fraction=cfg.test_fraction, seed=cfg.seed,
-            augment=cfg.augment,
+            augment=host_augment,
         )
-        host_augment = cfg.augment
     else:
         from featurenet_tpu.data.offline import VoxelCacheDataset
 
-        host_augment = cfg.augment and not cfg.device_augment
         ds = VoxelCacheDataset(
             cfg.data_cache, global_batch=cfg.global_batch, split="train",
             test_fraction=cfg.test_fraction, seed=cfg.seed,

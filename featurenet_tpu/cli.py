@@ -49,6 +49,14 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="backend for stride-1 conv blocks (default xla)")
     p.add_argument("--seg-loss", choices=["balanced_ce", "ce_dice", "dice"],
                    help="segmentation loss variant (default balanced_ce)")
+    p.add_argument("--seg-input-context",
+                   choices=["none", "proj", "proj_coords"],
+                   help="segmenter input context channels (axis projections"
+                        " / + coords) for global through/blind reasoning")
+    p.add_argument("--seg-decoder-blocks", type=int,
+                   help="refine convs per decoder stage (default 1)")
+    p.add_argument("--seg-bottleneck-blocks", type=int,
+                   help="bottleneck convs (default 1)")
     p.add_argument("--hbm-cache", action="store_true", dest="hbm_cache",
                    help="upload the packed train split into device HBM "
                         "once and sample batches on device (classify + "
@@ -96,6 +104,7 @@ def _overrides(args) -> dict:
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
         "restart_every_steps", "steps_per_dispatch",
+        "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
         k: getattr(args, k, None)
@@ -192,6 +201,21 @@ def main(argv=None) -> None:
     p_exp.add_argument("--per-class", type=int, default=1000)
     p_exp.add_argument("--resolution", type=int, default=64)
     p_exp.add_argument("--seed", type=int, default=0)
+    p_exp.add_argument("--param-range", default=None,
+                       help="feature-parameter quantile window: 'mid', "
+                            "'tails', or 'lo,hi' (OOD-holdout caches; "
+                            "default: full range)")
+    p_ood = sub.add_parser("eval-ood", allow_abbrev=False,
+                           help="robustness report: fresh-draw accuracy "
+                                "under rotation/noise/morph/parameter-tail "
+                                "perturbation (featurenet_tpu.ood)")
+    p_ood.add_argument("--checkpoint-dir", required=True)
+    p_ood.add_argument("--per-class", type=int, default=50)
+    p_ood.add_argument("--seed", type=int, default=777)
+    p_ood.add_argument("--families", default=None,
+                       help="comma list: clean,rotation,noise,morph,tails")
+    p_ood.add_argument("--out", default=None,
+                       help="also write the report rows as a JSON file")
     p_seg = sub.add_parser("export-seg-data",
                            help="materialize multi-feature parts with "
                                 "per-voxel ground truth as a seg cache")
@@ -328,11 +352,28 @@ def main(argv=None) -> None:
     if args.cmd == "export-data":
         from featurenet_tpu.data.offline import export_synthetic_cache
 
+        pr = args.param_range
+        if pr and "," in pr:
+            pr = tuple(float(v) for v in pr.split(","))
         index = export_synthetic_cache(
             args.out, per_class=args.per_class,
-            resolution=args.resolution, seed=args.seed,
+            resolution=args.resolution, seed=args.seed, param_range=pr,
         )
-        print(json.dumps({"exported": index["counts"]}))
+        print(json.dumps({"exported": index["counts"],
+                          "param_range": index.get("param_range")}))
+        return
+    if args.cmd == "eval-ood":
+        from featurenet_tpu.ood import evaluate_ood
+
+        rows = evaluate_ood(
+            args.checkpoint_dir, per_class=args.per_class, seed=args.seed,
+            families=args.families.split(",") if args.families else None,
+        )
+        for r in rows:
+            print(json.dumps(r))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(rows, fh, indent=1)
         return
     if args.cmd == "export-seg-data":
         from featurenet_tpu.data.offline import export_seg_cache

@@ -57,6 +57,13 @@ class Config:
     # Segmentation loss variant (train/steps.segmentation_loss):
     # "balanced_ce", "ce_dice", or "dice".
     seg_loss: str = "balanced_ce"
+    # Segmenter architecture levers (round-4, driven by seg_diagnose's gap
+    # attribution — see models/segmenter.py): input context channels for
+    # the global through/blind signal, and decoder/bottleneck capacity for
+    # boundary assignment. Identity fields: they change the param tree.
+    seg_input_context: str = "none"
+    seg_decoder_blocks: int = 1
+    seg_bottleneck_blocks: int = 1
 
     # Optimization.
     optimizer: str = "adamw"
@@ -85,9 +92,10 @@ class Config:
     # (sharded over the mesh's data axis) and draw every train batch ON
     # DEVICE (train.steps.make_hbm_multi_train_step) — zero per-step
     # host→device input traffic. The natural fit for this benchmark's
-    # scale: the 24×1000 64³ split bit-packed is ~600 MB against 16 GB of
-    # v5e HBM. Classify + data_cache only; incompatible with spatial
-    # sharding (the resident array shards batch rows, not depth).
+    # scale: the 24×1000 64³ split bit-packed is ~600 MB (seg cache
+    # ~0.5 GB) against 16 GB of v5e HBM. Requires data_cache; incompatible
+    # with spatial sharding (the resident array shards batch rows, not
+    # depth). Augmentation runs in-step on device for both tasks.
     hbm_cache: bool = False
     # Pipelined dispatch: fuse this many train steps into one XLA
     # executable (train.steps.make_multi_train_step), so one host→device
@@ -130,13 +138,20 @@ class Config:
     def device_augment(self) -> bool:
         """Whether pose augmentation runs inside the compiled train step
         (ops/augment.py) rather than in host data workers. Single source of
-        truth shared by the Trainer and the host-feed benchmark: cache-backed
+        truth shared by the Trainer and the host-feed benchmark.
+
+        HBM-resident mode: always in-step when augmenting — there is no
+        host pass; segment rotates voxels + per-voxel targets jointly
+        (random_rotate_batch_paired). Streamed mode: cache-backed
         classification only — synthetic streaming randomizes pose at
-        generation, and segmentation must rotate per-voxel targets with the
-        part on the host."""
+        generation, and streamed segmentation rotates on the host."""
+        if not (self.augment and self.augment_groups > 0):
+            return False
+        if self.hbm_cache:
+            return True
         return bool(
-            self.data_cache and self.augment and self.augment_device
-            and self.augment_groups > 0 and self.task == "classify"
+            self.data_cache and self.augment_device
+            and self.task == "classify"
         )
 
     def validate(self) -> "Config":
@@ -144,6 +159,14 @@ class Config:
             raise ValueError(f"unknown task {self.task!r}")
         if self.seg_loss not in ("balanced_ce", "ce_dice", "dice"):
             raise ValueError(f"unknown seg_loss {self.seg_loss!r}")
+        if self.seg_input_context not in ("none", "proj", "proj_coords"):
+            raise ValueError(
+                f"unknown seg_input_context {self.seg_input_context!r}"
+            )
+        if self.seg_decoder_blocks < 1 or self.seg_bottleneck_blocks < 1:
+            raise ValueError(
+                "seg_decoder_blocks and seg_bottleneck_blocks must be >= 1"
+            )
         if self.restart_every_steps is not None:
             if self.restart_every_steps <= 0:
                 raise ValueError(
@@ -158,8 +181,6 @@ class Config:
                     "mitigation off"
                 )
         if self.hbm_cache:
-            if self.task != "classify":
-                raise ValueError("hbm_cache supports task='classify' only")
             if self.spatial:
                 raise ValueError(
                     "hbm_cache is incompatible with spatial sharding: the "
@@ -171,15 +192,20 @@ class Config:
                     "hbm_cache requires data_cache (the split that gets "
                     "uploaded is the offline cache's train split)"
                 )
-            if self.augment and not (
-                self.augment_device and self.augment_groups > 0
-            ):
+            if self.augment and self.augment_groups < 1:
+                raise ValueError(
+                    "hbm_cache with augment=True needs augment_groups >= 1:"
+                    " the resident dataset's only augmentation path is the"
+                    " in-step device rotation"
+                )
+            if (self.task == "classify" and self.augment
+                    and not self.augment_device):
                 raise ValueError(
                     "hbm_cache with augment=True requires device "
-                    "augmentation (augment_device=True, augment_groups>=1):"
-                    " the resident dataset has no host-side augmentation "
-                    "path, so augment=True would otherwise be silently "
-                    "ignored — pass augment=False to train unaugmented"
+                    "augmentation (augment_device=True): the resident "
+                    "dataset has no host-side augmentation path, so "
+                    "augment=True would otherwise be silently ignored — "
+                    "pass augment=False to train unaugmented"
                 )
         if self.steps_per_dispatch < 1:
             raise ValueError(
@@ -415,7 +441,10 @@ def config_from_dict(d: dict) -> Config:
 # Fields that define the trained artifact itself: a checkpoint only restores
 # (meaningfully) under these exact values. Everything else — schedules, data
 # paths, logging — is run policy and freely overridable at eval/infer time.
-IDENTITY_FIELDS = ("task", "resolution", "arch", "seg_features")
+IDENTITY_FIELDS = (
+    "task", "resolution", "arch", "seg_features",
+    "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
+)
 
 
 def _identity_view(cfg: Config, field: str):

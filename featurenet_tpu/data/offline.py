@@ -197,12 +197,17 @@ def export_synthetic_cache(
     resolution: int = 64,
     seed: int = 0,
     orient: bool = True,
+    param_range=None,
 ) -> dict:
     """Materialize the parametric generator into the packed cache format.
 
     Gives a *fixed* dataset (reproducible from the seed) with a stable
     train/test split downstream — the on-disk analog of the reference's
-    24 × 1000 benchmark.
+    24 × 1000 benchmark. ``param_range`` restricts every feature
+    generator's size/position draws to a quantile window
+    (``"mid"``/``"tails"``/``(lo, hi)`` — see ``synthetic._ParamRange``);
+    the OOD holdout protocol trains on a ``"mid"`` cache and evaluates on
+    tail draws.
     """
     if resolution % 8:
         raise ValueError("resolution must be divisible by 8 (packed wire)")
@@ -217,6 +222,11 @@ def export_synthetic_cache(
         # labels coincide with these anyway, but readers should never have
         # to rely on that coincidence.
         "label_ids": {cls: i for i, cls in enumerate(CLASS_NAMES)},
+        # Provenance for OOD-holdout caches ("mid"/"tails"/[lo, hi]/None).
+        "param_range": (
+            list(param_range) if isinstance(param_range, (tuple, list))
+            else param_range
+        ),
     }
     for cls_id, cls in enumerate(CLASS_NAMES):
         rng = np.random.default_rng(
@@ -230,7 +240,8 @@ def export_synthetic_cache(
         )
         for i in range(per_class):
             part, _, _ = generate_sample(
-                rng, resolution, label=cls_id, orient=orient
+                rng, resolution, label=cls_id, orient=orient,
+                param_range=param_range,
             )
             packed[i] = pack_voxels(part)
         np.save(os.path.join(out_root, f"{cls}.npy"), packed)
@@ -646,6 +657,31 @@ class SegCacheDataset:
             vox = pack_voxels(np.stack(rot_v))
             seg = np.stack(rot_s)
         return vox, seg
+
+    def materialize_split(
+        self, multiple_of: int = 1, num_shards: int = 1, shard_id: int = 0
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """This host's block of the device-resident (HBM) seg dataset.
+
+        Same contract as ``VoxelCacheDataset.materialize_split``; returns
+        ``(packed_voxels, seg_int8, n_global)``. Augmentation happens on
+        device (paired voxel+seg rotation inside the compiled step), so
+        the block is raw rows.
+        """
+        n = len(self.rows)
+        keep = n - (n % max(multiple_of, 1))
+        if keep < num_shards:
+            raise ValueError(
+                f"split has {n} rows; {keep} after trimming to a multiple "
+                f"of {multiple_of} — too few for {num_shards} feed groups"
+            )
+        order = np.random.default_rng(
+            np.random.SeedSequence([self.seed, 0x4B10C5])
+        ).permutation(n)[:keep]
+        lo = keep * shard_id // num_shards
+        hi = keep * (shard_id + 1) // num_shards
+        vox, seg = self._gather(order[lo:hi])
+        return vox, seg, keep
 
     def worker_iter(self, worker_id: int = 0, num_workers: int = 1
                     ) -> Iterator[dict[str, np.ndarray]]:

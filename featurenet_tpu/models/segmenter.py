@@ -10,6 +10,20 @@ resolution, and the head emits ``num_classes + 1`` per-voxel logits
 (class 0 = background / not-a-feature, matching
 ``featurenet_tpu.data.synthetic.generate_sample``'s ``seg`` encoding).
 
+Round-4 levers (driven by ``train/seg_diagnose.py``'s attribution of the
+round-3 IoU gap — BASELINE.md):
+
+- ``input_context``: the 0.050 through/blind family confusion is a GLOBAL
+  property — whether a carve reaches the opposite face — that an 8³
+  bottleneck sees only weakly. ``"proj"`` appends three axis-projection
+  channels (mean occupancy along each axis, broadcast back), which encode
+  "does an empty column run all the way through here" directly at the
+  input; ``"proj_coords"`` adds three normalized coordinate channels on
+  top. Pure reductions + broadcasts — negligible TPU cost.
+- ``decoder_blocks`` / ``bottleneck_blocks``: capacity for the ~0.14
+  inter-feature boundary-assignment term (extra refine convs per decoder
+  stage / bottleneck).
+
 TPU notes: everything stays NDHWC/bf16 like the classifier; transposed convs
 lower to regular convs on TPU (XLA rewrites them), so the whole decoder is
 MXU work. Skip concatenation is on the channel (minor) axis — free layout-wise.
@@ -25,6 +39,8 @@ from flax import linen as nn
 from featurenet_tpu.data.synthetic import NUM_CLASSES
 from featurenet_tpu.models.featurenet import ConvBNRelu
 
+INPUT_CONTEXTS = ("none", "proj", "proj_coords")
+
 
 class FeatureNetSegmenter(nn.Module):
     """Dense per-voxel classifier.
@@ -37,10 +53,35 @@ class FeatureNetSegmenter(nn.Module):
     features: Sequence[int] = (32, 64, 128)
     num_classes: int = NUM_CLASSES
     dtype: jnp.dtype = jnp.bfloat16
+    input_context: str = "none"
+    decoder_blocks: int = 1
+    bottleneck_blocks: int = 1
 
     @nn.compact
     def __call__(self, voxels, train: bool = False):
-        x = voxels.astype(self.dtype)
+        if self.input_context not in INPUT_CONTEXTS:
+            raise ValueError(
+                f"input_context {self.input_context!r} not in "
+                f"{INPUT_CONTEXTS}"
+            )
+        v = voxels.astype(jnp.float32)
+        chans = [v]
+        if self.input_context != "none":
+            # Axis-projection channels: mean occupancy along each spatial
+            # axis, broadcast back over it. A through-feature is an empty
+            # column spanning the whole part — visible here at the input,
+            # not only after the encoder has compressed it away.
+            for ax in (1, 2, 3):
+                chans.append(
+                    jnp.broadcast_to(v.mean(axis=ax, keepdims=True), v.shape)
+                )
+        if self.input_context == "proj_coords":
+            for ax, n in zip((1, 2, 3), v.shape[1:4]):
+                shape = [1, 1, 1, 1, 1]
+                shape[ax] = n
+                coord = jnp.linspace(0.0, 1.0, n).reshape(shape)
+                chans.append(jnp.broadcast_to(coord, v.shape))
+        x = jnp.concatenate(chans, axis=-1).astype(self.dtype)
         skips = []
         # Encoder: each stage = refine at-res, then strided downsample.
         for f in self.features:
@@ -48,7 +89,10 @@ class FeatureNetSegmenter(nn.Module):
             skips.append(x)
             x = ConvBNRelu(f, kernel=3, stride=2, dtype=self.dtype)(x, train)
         # Bottleneck.
-        x = ConvBNRelu(self.features[-1] * 2, kernel=3, dtype=self.dtype)(x, train)
+        for _ in range(self.bottleneck_blocks):
+            x = ConvBNRelu(
+                self.features[-1] * 2, kernel=3, dtype=self.dtype
+            )(x, train)
         # Decoder: transposed-conv upsample, concat skip, refine.
         for f, skip in zip(reversed(self.features), reversed(skips)):
             x = nn.ConvTranspose(
@@ -59,7 +103,8 @@ class FeatureNetSegmenter(nn.Module):
                 param_dtype=jnp.float32,
             )(x)
             x = jnp.concatenate([x, skip], axis=-1)
-            x = ConvBNRelu(f, kernel=3, dtype=self.dtype)(x, train)
+            for _ in range(self.decoder_blocks):
+                x = ConvBNRelu(f, kernel=3, dtype=self.dtype)(x, train)
         x = nn.Conv(
             self.num_classes + 1,
             kernel_size=(1, 1, 1),

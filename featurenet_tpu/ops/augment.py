@@ -64,13 +64,34 @@ def random_rotate_batch(
     voxels: jnp.ndarray, rng: jax.Array, groups: int = 8
 ) -> jnp.ndarray:
     """Rotate ``[B, R, R, R, C]`` voxels, one random pose per batch group."""
+    return random_rotate_batch_paired(voxels, None, rng, groups)[0]
+
+
+def random_rotate_batch_paired(
+    voxels: jnp.ndarray,
+    seg: jnp.ndarray | None,
+    rng: jax.Array,
+    groups: int = 8,
+) -> tuple[jnp.ndarray, jnp.ndarray | None]:
+    """Rotate voxels and (optionally) a per-voxel target with SHARED poses.
+
+    Segmentation targets must rotate with the part — ``seg`` is
+    ``[B, R, R, R]`` (any integer dtype; rotations are pure layout ops) and
+    each batch group gets the same group element applied to both arrays.
+    """
     b = voxels.shape[0]
     while b % groups:
         groups -= 1
     codes = jax.random.randint(rng, (groups,), 0, len(CUBE_GROUP))
     step = b // groups
-    parts = [
-        rotate_grids(voxels[i * step : (i + 1) * step], codes[i])
-        for i in range(groups)
-    ]
-    return jnp.concatenate(parts, axis=0)
+
+    def rot(x):
+        return jnp.concatenate(
+            [
+                rotate_grids(x[i * step : (i + 1) * step], codes[i])
+                for i in range(groups)
+            ],
+            axis=0,
+        )
+
+    return rot(voxels), (rot(seg) if seg is not None else None)

@@ -53,7 +53,12 @@ from featurenet_tpu.utils.logging import MetricLogger
 
 def build_model(cfg: Config):
     if cfg.task == "segment":
-        return FeatureNetSegmenter(features=tuple(cfg.seg_features))
+        return FeatureNetSegmenter(
+            features=tuple(cfg.seg_features),
+            input_context=cfg.seg_input_context,
+            decoder_blocks=cfg.seg_decoder_blocks,
+            bottleneck_blocks=cfg.seg_bottleneck_blocks,
+        )
     return FeatureNet(arch=cfg.arch)
 
 
@@ -260,25 +265,29 @@ class Trainer:
         if self._hbm:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            blk_vox, blk_lab, n_keep = self.train_data.materialize_split(
+            blk_vox, blk_tgt, n_keep = self.train_data.materialize_split(
                 multiple_of=self.mesh.shape["data"],
                 num_shards=n_hosts,
                 shard_id=host_id,
             )
+            if cfg.task != "segment":
+                blk_tgt = blk_tgt.astype(np.int32)
             d_sh = NamedSharding(self.mesh, P("data"))
             if jax.process_count() == 1:
                 self._hbm_data = jax.device_put(blk_vox, d_sh)
-                self._hbm_labels = jax.device_put(
-                    blk_lab.astype(np.int32), d_sh
-                )
+                self._hbm_labels = jax.device_put(blk_tgt, d_sh)
             else:
                 self._hbm_data = jax.make_array_from_process_local_data(
                     d_sh, blk_vox
                 )
                 self._hbm_labels = jax.make_array_from_process_local_data(
-                    d_sh, blk_lab.astype(np.int32)
+                    d_sh, blk_tgt
                 )
 
+            # Augmentation in HBM mode is necessarily in-step (there is no
+            # host pass): classify rotates voxels, segment rotates
+            # voxels+seg jointly. cfg.device_augment is the single source
+            # of truth and covers the hbm_cache case.
             def _hbm_jit(n_steps: int):
                 return jax.jit(
                     make_hbm_multi_train_step(
@@ -288,6 +297,7 @@ class Trainer:
                             cfg.augment_groups if self._device_aug else 0
                         ),
                         num_steps=n_steps,
+                        seg_loss=cfg.seg_loss,
                     ),
                     in_shardings=(self.state_sh, d_sh, d_sh, rep),
                     out_shardings=(self.state_sh, rep),

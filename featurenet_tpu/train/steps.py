@@ -126,14 +126,12 @@ def make_train_step(
     """Build the pure train-step function (jit it with shardings at call site).
 
     ``augment_groups > 0`` applies device-side cube-group pose augmentation
-    (ops/augment.py) to the voxels inside the compiled step — classification
-    only (the label is pose-invariant; per-voxel targets would need the same
-    rotation). ``packed=True`` expects bit-packed wire voxels (the classify
-    wire format) and unpacks them on device.
+    (ops/augment.py) inside the compiled step: classification rotates the
+    voxels (the label is pose-invariant); segmentation rotates voxels and
+    the per-voxel target jointly with shared group elements
+    (``random_rotate_batch_paired``). ``packed=True`` expects bit-packed
+    wire voxels and unpacks them on device.
     """
-
-    if augment_groups and task != "classify":
-        raise ValueError("device augmentation supports task='classify' only")
 
     target_key = "label" if task == "classify" else "seg"
 
@@ -158,12 +156,20 @@ def make_train_step(
         step_rng = jax.random.fold_in(rng, state.step)
         dropout_rng, aug_rng = jax.random.split(step_rng)
         voxels = _batch_voxels(batch, packed)
+        target = batch[target_key]
         if augment_groups:
-            from featurenet_tpu.ops.augment import random_rotate_batch
+            from featurenet_tpu.ops.augment import (
+                random_rotate_batch_paired,
+            )
 
-            voxels = random_rotate_batch(voxels, aug_rng, augment_groups)
+            voxels, rot_target = random_rotate_batch_paired(
+                voxels, target if task == "segment" else None,
+                aug_rng, augment_groups,
+            )
+            if task == "segment":
+                target = rot_target
         grads, (new_stats, metrics) = jax.grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, voxels, batch[target_key],
+            state.params, state.batch_stats, voxels, target,
             dropout_rng
         )
         state = state.apply_gradients(grads=grads, batch_stats=new_stats)
@@ -224,16 +230,18 @@ def make_hbm_multi_train_step(
     label_smoothing: float = 0.0,
     augment_groups: int = 0,
     num_steps: int = 1,
+    seg_loss: str = "balanced_ce",
 ) -> Callable:
     """Train steps that SAMPLE THEIR BATCHES FROM HBM — zero per-step host
     traffic.
 
     The 24×1000 64³ benchmark bit-packed is ~750 MB: it fits in a v5e
-    chip's 16 GB HBM outright, so the TPU-native input pipeline for this
-    dataset scale is *device residency* — upload the packed train split
-    once, then every train step draws its batch on device. Takes
-    ``(state, data, labels, rng)`` where ``data`` is uint8
-    ``[N, R, R, R/8]`` and ``labels`` int32 ``[N]``, both sharded
+    chip's 16 GB HBM outright (the seg cache ~0.5 GB), so the TPU-native
+    input pipeline for this dataset scale is *device residency* — upload
+    the packed train split once, then every train step draws its batch on
+    device. Takes ``(state, data, targets, rng)`` where ``data`` is uint8
+    ``[N, R, R, R/8]`` and ``targets`` is int32 labels ``[N]`` (classify)
+    or int8 seg ``[N, R, R, R]`` (segment), both sharded
     ``P('data')`` along dim 0 over the mesh. Each data-axis shard draws
     its ``global_batch / data_axis`` rows uniformly from its own block via
     ``shard_map`` (decorrelated per shard by ``axis_index``), so sampling
@@ -250,13 +258,12 @@ def make_hbm_multi_train_step(
     """
     if num_steps < 1:
         raise ValueError(f"num_steps must be >= 1, got {num_steps}")
-    if task != "classify":
-        raise ValueError("HBM-resident sampling supports classify only")
     from jax.sharding import PartitionSpec as P
 
+    target_key = "label" if task == "classify" else "seg"
     step = make_train_step(
         model, task, label_smoothing,
-        augment_groups=augment_groups, packed=True,
+        augment_groups=augment_groups, packed=True, seg_loss=seg_loss,
     )
     data_axis = mesh.shape["data"]
     if global_batch % data_axis:
@@ -266,7 +273,7 @@ def make_hbm_multi_train_step(
         )
     local_batch = global_batch // data_axis
 
-    def draw(key, data_local, labels_local):
+    def draw(key, data_local, targets_local):
         # Per-shard decorrelation: each data-axis block draws with its own
         # fold of the step key from its own [n_local] row range.
         ax = jax.lax.axis_index("data")
@@ -276,7 +283,7 @@ def make_hbm_multi_train_step(
         )
         return (
             jnp.take(data_local, idx, axis=0),
-            jnp.take(labels_local, idx, axis=0),
+            jnp.take(targets_local, idx, axis=0),
         )
 
     shard_draw = jax.shard_map(
@@ -287,7 +294,7 @@ def make_hbm_multi_train_step(
         check_vma=False,
     )
 
-    def multi_step(state: TrainState, data, labels, rng):
+    def multi_step(state: TrainState, data, targets, rng):
         metrics = None
         for _ in range(num_steps):
             # state.step advances per inner step, so each draw key and each
@@ -296,9 +303,9 @@ def make_hbm_multi_train_step(
             dkey = jax.random.fold_in(
                 jax.random.fold_in(rng, state.step), 0x5A11
             )
-            voxels, lab = shard_draw(dkey, data, labels)
+            voxels, tgt = shard_draw(dkey, data, targets)
             state, metrics = step(
-                state, {"voxels": voxels, "label": lab}, rng
+                state, {"voxels": voxels, target_key: tgt}, rng
             )
         return state, metrics
 
