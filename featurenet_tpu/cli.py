@@ -32,6 +32,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--peak-lr", type=float)
     p.add_argument("--total-steps", type=int)
     p.add_argument("--seed", type=int)
+    p.add_argument("--grad-clip", type=float, dest="grad_clip",
+                   help="global-norm gradient clipping (0 = off)")
     p.add_argument("--checkpoint-dir")
     p.add_argument("--mesh-model", type=int)
     p.add_argument("--data-workers", type=int)
@@ -103,7 +105,7 @@ def _overrides(args) -> dict:
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
-        "restart_every_steps", "steps_per_dispatch",
+        "restart_every_steps", "steps_per_dispatch", "grad_clip",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
@@ -205,6 +207,15 @@ def main(argv=None) -> None:
                        help="feature-parameter quantile window: 'mid', "
                             "'tails', or 'lo,hi' (OOD-holdout caches; "
                             "default: full range)")
+    p_exp.add_argument("--mesh-pose", default="none",
+                       choices=["none", "remesh", "so3"],
+                       help="route parts through the STL pipeline: "
+                            "'remesh' = STL normalization, identity pose; "
+                            "'so3' = + uniform random rotation "
+                            "(OOD-robust training caches)")
+    p_exp.add_argument("--margin-jitter", default=None,
+                       help="'lo,hi': per-sample normalization margin "
+                            "(scale augmentation; default fixed 0.05)")
     p_ood = sub.add_parser("eval-ood", allow_abbrev=False,
                            help="robustness report: fresh-draw accuracy "
                                 "under rotation/noise/morph/parameter-tail "
@@ -213,7 +224,7 @@ def main(argv=None) -> None:
     p_ood.add_argument("--per-class", type=int, default=50)
     p_ood.add_argument("--seed", type=int, default=777)
     p_ood.add_argument("--families", default=None,
-                       help="comma list: clean,rotation,noise,morph,tails")
+                       help="comma list: clean,rotation,noise,morph,tails,scale")
     p_ood.add_argument("--out", default=None,
                        help="also write the report rows as a JSON file")
     p_seg = sub.add_parser("export-seg-data",
@@ -355,12 +366,18 @@ def main(argv=None) -> None:
         pr = args.param_range
         if pr and "," in pr:
             pr = tuple(float(v) for v in pr.split(","))
+        mj = args.margin_jitter
+        if mj:
+            mj = tuple(float(v) for v in mj.split(","))
         index = export_synthetic_cache(
             args.out, per_class=args.per_class,
             resolution=args.resolution, seed=args.seed, param_range=pr,
+            mesh_pose=args.mesh_pose, margin_jitter=mj,
         )
         print(json.dumps({"exported": index["counts"],
-                          "param_range": index.get("param_range")}))
+                          "param_range": index.get("param_range"),
+                          "mesh_pose": index.get("mesh_pose"),
+                          "margin_jitter": index.get("margin_jitter")}))
         return
     if args.cmd == "eval-ood":
         from featurenet_tpu.ood import evaluate_ood

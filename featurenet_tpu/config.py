@@ -72,6 +72,10 @@ class Config:
     warmup_steps: int = 100
     total_steps: int = 3000
     label_smoothing: float = 0.0
+    # Global-norm gradient clipping; 0 disables. Recipe-stability lever for
+    # rough loss surfaces (the warp64 stride-4 stem's mid-schedule eval
+    # collapses — BASELINE.md round-3/4 recipe study).
+    grad_clip: float = 0.0
 
     # Parallelism (mesh axis sizes; None = use all available devices on data).
     mesh_data: Optional[int] = None

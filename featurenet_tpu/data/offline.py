@@ -198,6 +198,8 @@ def export_synthetic_cache(
     seed: int = 0,
     orient: bool = True,
     param_range=None,
+    mesh_pose: str = "none",
+    margin_jitter: tuple | None = None,
 ) -> dict:
     """Materialize the parametric generator into the packed cache format.
 
@@ -208,7 +210,21 @@ def export_synthetic_cache(
     (``"mid"``/``"tails"``/``(lo, hi)`` — see ``synthetic._ParamRange``);
     the OOD holdout protocol trains on a ``"mid"`` cache and evaluates on
     tail draws.
+
+    ``mesh_pose``: route each part through the STL pipeline
+    (``voxels_to_mesh`` → ``voxelize``) before packing — ``"remesh"``
+    keeps the identity pose (STL normalization only, matching
+    ``build-cache`` output), ``"so3"`` additionally applies a uniform
+    random rotation (the OOD-robust training cache: arbitrary poses with
+    exact parity-filled geometry). ``margin_jitter=(lo, hi)`` draws the
+    normalization margin per sample — scale augmentation against the
+    margin-shift brittleness the round-4 OOD harness measured.
     """
+    if mesh_pose not in ("none", "remesh", "so3"):
+        raise ValueError(
+            f"mesh_pose {mesh_pose!r}: expected 'none', 'remesh', or 'so3'"
+        )
+    use_mesh = mesh_pose != "none" or margin_jitter is not None
     if resolution % 8:
         raise ValueError("resolution must be divisible by 8 (packed wire)")
     os.makedirs(out_root, exist_ok=True)
@@ -227,6 +243,8 @@ def export_synthetic_cache(
             list(param_range) if isinstance(param_range, (tuple, list))
             else param_range
         ),
+        "mesh_pose": mesh_pose,
+        "margin_jitter": list(margin_jitter) if margin_jitter else None,
     }
     for cls_id, cls in enumerate(CLASS_NAMES):
         rng = np.random.default_rng(
@@ -243,6 +261,22 @@ def export_synthetic_cache(
                 rng, resolution, label=cls_id, orient=orient,
                 param_range=param_range,
             )
+            if use_mesh:
+                from featurenet_tpu.data.voxel_to_mesh import (
+                    random_rotation_matrix,
+                    rotate_mesh,
+                    voxels_to_mesh,
+                )
+                from featurenet_tpu.data.voxelize import voxelize
+
+                tris = voxels_to_mesh(part.astype(bool))
+                if mesh_pose == "so3":
+                    tris = rotate_mesh(tris, random_rotation_matrix(rng))
+                m = (
+                    0.05 if margin_jitter is None
+                    else float(rng.uniform(*margin_jitter))
+                )
+                part = voxelize(tris, resolution, fill=True, margin=m)
             packed[i] = pack_voxels(part)
         np.save(os.path.join(out_root, f"{cls}.npy"), packed)
         with open(os.path.join(out_root, f"{cls}.files.json"), "w") as fh:

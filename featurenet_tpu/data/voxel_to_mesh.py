@@ -33,6 +33,44 @@ _DIRECTIONS = (
 )
 
 
+def random_rotation_matrix(
+    rng: np.random.Generator, angle_deg=None
+) -> np.ndarray:
+    """Random 3D rotation: uniform over SO(3) (``angle_deg=None``, via a
+    normalized quaternion) or a fixed angle about a uniformly random axis
+    (Rodrigues). Shared by the OOD harness (mesh-space rotation
+    perturbation) and pose-augmented exports."""
+    if angle_deg is None:
+        q = rng.normal(size=4)
+        w, x, y, z = q / np.linalg.norm(q)
+        return np.array([
+            [1 - 2 * (y * y + z * z), 2 * (x * y - w * z),
+             2 * (x * z + w * y)],
+            [2 * (x * y + w * z), 1 - 2 * (x * x + z * z),
+             2 * (y * z - w * x)],
+            [2 * (x * z - w * y), 2 * (y * z + w * x),
+             1 - 2 * (x * x + y * y)],
+        ], dtype=np.float64)
+    axis = rng.normal(size=3)
+    axis /= np.linalg.norm(axis)
+    a = np.deg2rad(float(angle_deg))
+    K = np.array([
+        [0, -axis[2], axis[1]],
+        [axis[2], 0, -axis[0]],
+        [-axis[1], axis[0], 0],
+    ])
+    return np.eye(3) + np.sin(a) * K + (1 - np.cos(a)) * (K @ K)
+
+
+def rotate_mesh(tris: np.ndarray, rot: np.ndarray) -> np.ndarray:
+    """Rotate ``[n, 3, 3]`` triangles about their bounding-box center."""
+    pts = tris.reshape(-1, 3)
+    center = (pts.min(0) + pts.max(0)) / 2.0
+    return ((pts - center) @ rot.T + center).reshape(-1, 3, 3).astype(
+        np.float32
+    )
+
+
 def _face_quads(cells: np.ndarray, axis: int, positive: bool) -> np.ndarray:
     """Quad corners ``[n, 4, 3]`` (float32, voxel-index coords) for boundary
     faces of ``cells [n, 3]`` in direction ``axis``/``positive``."""

@@ -21,9 +21,18 @@ distribution shift directly. Families:
              (``synthetic.param_range``). Against a full-range-trained
              model this is mild shift; the stronger protocol trains on a
              ``param_range="mid"`` cache and evaluates here.
+  scale    — the part re-normalized at a different margin (uniform
+             shrink/grow of a few voxels). Added after the first harness
+             run exposed that raw generator grids (0.08-margin stock)
+             score near CHANCE against an STL-cache-trained model whose
+             parts were normalized at margin 0.05 — a ~7% uniform scale
+             shift, measured here as its own dose-response family.
 
 All families evaluate FRESH generator draws (never any split of a training
-cache), seeded independently of the training seeds, balanced per class.
+cache), seeded independently of the training seeds, balanced per class —
+and every family passes through the SAME mesh→voxelize pipeline the STL
+benchmark uses (``voxels_to_mesh`` → ``voxelize`` at the default margin),
+so the clean row is the training modality, not the raw generator grid.
 """
 
 from __future__ import annotations
@@ -31,7 +40,11 @@ from __future__ import annotations
 import numpy as np
 
 from featurenet_tpu.data.synthetic import CLASS_NAMES, generate_sample
-from featurenet_tpu.data.voxel_to_mesh import voxels_to_mesh
+from featurenet_tpu.data.voxel_to_mesh import (
+    random_rotation_matrix,
+    rotate_mesh,
+    voxels_to_mesh,
+)
 from featurenet_tpu.data.voxelize import voxelize
 
 NUM_CLASSES = len(CLASS_NAMES)
@@ -51,30 +64,9 @@ DEFAULT_LEVELS: tuple = (
     ("morph", "dilate"),
     ("morph", "erode"),
     ("tails", None),
+    ("scale", 0.08),
+    ("scale", 0.11),
 )
-
-
-def _rotation_matrix(rng: np.random.Generator, angle_deg=None) -> np.ndarray:
-    """Random rotation: uniform over SO(3) (``angle_deg=None``) or a fixed
-    angle about a uniformly random axis (Rodrigues)."""
-    if angle_deg is None:
-        # Uniform SO(3) via normalized quaternion.
-        q = rng.normal(size=4)
-        w, x, y, z = q / np.linalg.norm(q)
-        return np.array([
-            [1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y)],
-            [2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x)],
-            [2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y)],
-        ], dtype=np.float64)
-    axis = rng.normal(size=3)
-    axis /= np.linalg.norm(axis)
-    a = np.deg2rad(float(angle_deg))
-    K = np.array([
-        [0, -axis[2], axis[1]],
-        [axis[2], 0, -axis[0]],
-        [-axis[1], axis[0], 0],
-    ])
-    return np.eye(3) + np.sin(a) * K + (1 - np.cos(a)) * (K @ K)
 
 
 def rotate_part(
@@ -86,11 +78,11 @@ def rotate_part(
     ``voxelize`` re-normalizes into the unit cube the way the STL pipeline
     normalizes every benchmark part."""
     R = grid.shape[0]
-    tris = voxels_to_mesh(grid.astype(bool))
-    rot = _rotation_matrix(rng, angle_deg)
-    center = (tris.reshape(-1, 3).min(0) + tris.reshape(-1, 3).max(0)) / 2.0
-    tris = (tris.reshape(-1, 3) - center) @ rot.T + center
-    return voxelize(tris.reshape(-1, 3, 3), R, fill=True)
+    tris = rotate_mesh(
+        voxels_to_mesh(grid.astype(bool)),
+        random_rotation_matrix(rng, angle_deg),
+    )
+    return voxelize(tris, R, fill=True)
 
 
 def _shift(g: np.ndarray, ax: int, d: int) -> np.ndarray:
@@ -119,16 +111,31 @@ def erode(g: np.ndarray) -> np.ndarray:
     return ~dilate(~g)
 
 
+def remesh(grid: np.ndarray, margin: float = 0.05) -> np.ndarray:
+    """Pass a voxel part through the benchmark's mesh→voxel pipeline
+    (exact surface extraction, re-normalization at ``margin``, parity
+    fill). This is the normalization every STL-built training cache went
+    through — fresh generator grids must take the same path or the
+    'clean' row measures a scale shift, not the model."""
+    R = grid.shape[0]
+    return voxelize(voxels_to_mesh(grid.astype(bool)), R, fill=True,
+                    margin=margin)
+
+
 def _perturb(family: str, level, grid: np.ndarray, rng) -> np.ndarray:
     g = grid.astype(bool)
     if family in ("clean", "tails"):
-        return g
+        return remesh(g)
     if family == "rotation":
+        # rotate_part re-voxelizes at the default margin itself.
         return rotate_part(g, rng, None if level == "so3" else float(level))
     if family == "noise":
-        return g ^ (rng.random(g.shape) < float(level))
+        return remesh(g) ^ (rng.random(g.shape) < float(level))
     if family == "morph":
+        g = remesh(g)
         return dilate(g) if level == "dilate" else erode(g)
+    if family == "scale":
+        return remesh(g, margin=float(level))
     raise ValueError(f"unknown OOD family {family!r}")
 
 
@@ -155,7 +162,7 @@ def evaluate_ood(
         raise ValueError("evaluate_ood runs on classification checkpoints")
     R = p.cfg.resolution
 
-    known = {"clean", "rotation", "noise", "morph", "tails"}
+    known = {"clean", "rotation", "noise", "morph", "tails", "scale"}
     if families:
         bad = sorted(set(families) - known)
         if bad:
@@ -229,7 +236,7 @@ def main(argv=None) -> None:
     ap.add_argument("--per-class", type=int, default=50)
     ap.add_argument("--seed", type=int, default=777)
     ap.add_argument("--families", default=None,
-                    help="comma list: clean,rotation,noise,morph,tails")
+                    help="comma list: clean,rotation,noise,morph,tails,scale")
     ap.add_argument("--out", default=None, help="also write rows as JSON")
     args = ap.parse_args(argv)
     fams = args.families.split(",") if args.families else None

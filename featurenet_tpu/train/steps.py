@@ -436,9 +436,14 @@ def make_lr_schedule(
 def make_optimizer(cfg) -> optax.GradientTransformation:
     sched = make_lr_schedule(cfg.peak_lr, cfg.warmup_steps, cfg.total_steps)
     if cfg.optimizer == "adamw":
-        return optax.adamw(sched, weight_decay=cfg.weight_decay)
-    if cfg.optimizer == "adam":
-        return optax.adam(sched)
-    if cfg.optimizer == "sgd":
-        return optax.sgd(sched, momentum=0.9)
-    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+        tx = optax.adamw(sched, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "adam":
+        tx = optax.adam(sched)
+    elif cfg.optimizer == "sgd":
+        tx = optax.sgd(sched, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    clip = getattr(cfg, "grad_clip", 0.0) or 0.0
+    if clip > 0.0:
+        tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+    return tx
