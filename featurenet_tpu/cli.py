@@ -43,6 +43,9 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                                     "event files here")
     p.add_argument("--no-augment", action="store_true",
                    help="disable train-time pose augmentation (cache-backed)")
+    p.add_argument("--augment-noise", type=float, dest="augment_noise",
+                   help="train-time occupancy bit-flip rate (robustness "
+                        "augmentation, applied on device; 0 = off)")
     p.add_argument("--no-stem-s2d", action="store_true",
                    help="use the direct strided conv instead of the "
                         "space-to-depth stem (matches checkpoints trained "
@@ -106,6 +109,7 @@ def _overrides(args) -> dict:
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
+        "augment_noise",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {

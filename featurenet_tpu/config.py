@@ -50,6 +50,11 @@ class Config:
     augment: bool = True
     augment_device: bool = True
     augment_groups: int = 8
+    # Occupancy bit-flip augmentation inside the compiled step (fraction of
+    # voxels flipped per sample; 0 = off). Robustness lever: the round-4
+    # OOD harness measured 0.5% flips costing the unaugmented flagship 39
+    # accuracy points.
+    augment_noise: float = 0.0
 
     # Model.
     arch: FeatureNetArch = dataclasses.field(default_factory=FeatureNetArch)

@@ -136,6 +136,7 @@ class Trainer:
             augment_groups=cfg.augment_groups if self._device_aug else 0,
             packed=packed,
             seg_loss=cfg.seg_loss,
+            augment_noise=cfg.augment_noise,
         )
         self._train_step = jax.jit(
             make_train_step(self.model, cfg.task, **step_kw),
@@ -298,6 +299,7 @@ class Trainer:
                         ),
                         num_steps=n_steps,
                         seg_loss=cfg.seg_loss,
+                        augment_noise=cfg.augment_noise,
                     ),
                     in_shardings=(self.state_sh, d_sh, d_sh, rep),
                     out_shardings=(self.state_sh, rep),

@@ -122,6 +122,7 @@ def make_train_step(
     augment_groups: int = 0,
     packed: bool = False,
     seg_loss: str = "balanced_ce",
+    augment_noise: float = 0.0,
 ) -> Callable:
     """Build the pure train-step function (jit it with shardings at call site).
 
@@ -154,9 +155,16 @@ def make_train_step(
     def train_step(state: TrainState, batch, rng):
         # Fold the step index in so dropout differs per step from one base key.
         step_rng = jax.random.fold_in(rng, state.step)
-        dropout_rng, aug_rng = jax.random.split(step_rng)
+        dropout_rng, aug_rng, noise_rng = jax.random.split(step_rng, 3)
         voxels = _batch_voxels(batch, packed)
         target = batch[target_key]
+        if augment_noise > 0.0:
+            # Occupancy bit-flips (the OOD harness's noise model): XOR on
+            # the 0/1 grid, fused into the unpack — VPU-cheap.
+            flip = jax.random.bernoulli(
+                noise_rng, augment_noise, voxels.shape
+            )
+            voxels = jnp.abs(voxels - flip.astype(voxels.dtype))
         if augment_groups:
             from featurenet_tpu.ops.augment import (
                 random_rotate_batch_paired,
@@ -187,6 +195,7 @@ def make_multi_train_step(
     packed: bool = False,
     seg_loss: str = "balanced_ce",
     num_steps: int = 2,
+    augment_noise: float = 0.0,
 ) -> Callable:
     """``num_steps`` train steps fused into ONE XLA executable.
 
@@ -211,6 +220,7 @@ def make_multi_train_step(
     step = make_train_step(
         model, task, label_smoothing,
         augment_groups=augment_groups, packed=packed, seg_loss=seg_loss,
+        augment_noise=augment_noise,
     )
 
     def multi_step(state: TrainState, batches, rng):
@@ -231,6 +241,7 @@ def make_hbm_multi_train_step(
     augment_groups: int = 0,
     num_steps: int = 1,
     seg_loss: str = "balanced_ce",
+    augment_noise: float = 0.0,
 ) -> Callable:
     """Train steps that SAMPLE THEIR BATCHES FROM HBM — zero per-step host
     traffic.
@@ -264,6 +275,7 @@ def make_hbm_multi_train_step(
     step = make_train_step(
         model, task, label_smoothing,
         augment_groups=augment_groups, packed=True, seg_loss=seg_loss,
+        augment_noise=augment_noise,
     )
     data_axis = mesh.shape["data"]
     if global_batch % data_axis:

@@ -134,7 +134,7 @@ def test_hbm_resident_training(tmp_path):
     cfg = get_config(
         "smoke16", data_cache=cache, hbm_cache=True, steps_per_dispatch=4,
         global_batch=16, total_steps=10, log_every=5, eval_every=10**9,
-        checkpoint_every=10**9, data_workers=1,
+        checkpoint_every=10**9, data_workers=1, augment_noise=0.01,
     )
     t = Trainer(cfg)
     last = t.run()
