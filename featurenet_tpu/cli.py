@@ -43,6 +43,11 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                                     "event files here")
     p.add_argument("--no-augment", action="store_true",
                    help="disable train-time pose augmentation (cache-backed)")
+    p.add_argument("--augment-affine", action="store_true",
+                   dest="augment_affine",
+                   help="arbitrary-angle SO(3)+scale augmentation on "
+                        "device (OOD-robust training; replaces cube-group "
+                        "rotation; classify only)")
     p.add_argument("--augment-noise", type=float, dest="augment_noise",
                    help="train-time occupancy bit-flip rate (robustness "
                         "augmentation, applied on device; 0 = off)")
@@ -62,6 +67,10 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="refine convs per decoder stage (default 1)")
     p.add_argument("--seg-bottleneck-blocks", type=int,
                    help="bottleneck convs (default 1)")
+    p.add_argument("--no-spatial", action="store_true", dest="no_spatial",
+                   help="disable spatial (depth-over-'model') sharding "
+                        "(e.g. single-chip runs of presets that ship "
+                        "pod-scale spatial meshes, or --hbm-cache)")
     p.add_argument("--hbm-cache", action="store_true", dest="hbm_cache",
                    help="upload the packed train split into device HBM "
                         "once and sample batches on device (classify + "
@@ -121,6 +130,10 @@ def _overrides(args) -> dict:
         out["augment"] = False
     if getattr(args, "hbm_cache", False):
         out["hbm_cache"] = True
+    if getattr(args, "augment_affine", False):
+        out["augment_affine"] = True
+    if getattr(args, "no_spatial", False):
+        out["spatial"] = False
     return out
 
 

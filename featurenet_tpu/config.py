@@ -55,6 +55,12 @@ class Config:
     # OOD harness measured 0.5% flips costing the unaugmented flagship 39
     # accuracy points.
     augment_noise: float = 0.0
+    # Arbitrary-angle SO(3) rotation + uniform scale resampling inside the
+    # compiled step (ops/augment.random_affine_batch) — replaces the
+    # cube-group rotation when on. The OOD-robustness training mode:
+    # infinite pose diversity (a statically rotated cache overfits),
+    # classify only.
+    augment_affine: bool = False
 
     # Model.
     arch: FeatureNetArch = dataclasses.field(default_factory=FeatureNetArch)
@@ -216,6 +222,24 @@ class Config:
                     "augment=True would otherwise be silently ignored — "
                     "pass augment=False to train unaugmented"
                 )
+        if self.augment_affine and self.task != "classify":
+            raise ValueError(
+                "augment_affine supports task='classify' only (per-voxel "
+                "targets would need the same resample)"
+            )
+        if self.augment_affine and not self.device_augment:
+            raise ValueError(
+                "augment_affine runs inside the compiled step and needs "
+                "device augmentation active (augment=True, "
+                "augment_groups>=1, and a data_cache with "
+                "augment_device=True or hbm_cache) — as configured the "
+                "flag would be silently ignored"
+            )
+        if not (0.0 <= self.augment_noise < 0.5):
+            raise ValueError(
+                f"augment_noise is a per-voxel bit-flip probability in "
+                f"[0, 0.5); got {self.augment_noise} (0.01 = 1% of voxels)"
+            )
         if self.steps_per_dispatch < 1:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got "

@@ -60,6 +60,90 @@ def rotate_grids(x: jnp.ndarray, code, spatial_start: int = 1):
     return jax.lax.switch(code, branches, x)
 
 
+def _quat_to_matrix(q: jnp.ndarray) -> jnp.ndarray:
+    """Unit quaternion [4] → rotation matrix [3,3] (uniform over SO(3)
+    when q is a normalized iid-normal draw)."""
+    q = q / jnp.linalg.norm(q)
+    w, x, y, z = q[0], q[1], q[2], q[3]
+    return jnp.array([
+        [1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y)],
+        [2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x)],
+        [2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y)],
+    ])
+
+
+def random_affine_batch(
+    voxels: jnp.ndarray,
+    rng: jax.Array,
+    groups: int = 8,
+    scale_range: tuple[float, float] = (0.7, 1.05),
+) -> jnp.ndarray:
+    """Arbitrary-angle SO(3) rotation + uniform scale, inside the step.
+
+    The cube group (``random_rotate_batch``) covers only the 24 axis-
+    aligned poses; round 4's OOD harness measured the flagship collapsing
+    to chance at a 5° off-axis rotation, and a *statically* rotated
+    training cache (one pose per part) overfits instead of generalizing —
+    pose diversity must be infinite, i.e. drawn per step on device. Each
+    batch group gets one random rotation (uniform SO(3) via quaternion)
+    composed with one uniform scale draw; voxels are trilinearly resampled
+    (``jax.scipy.ndimage.map_coordinates``) through the inverse affine
+    about the grid center. The scale range defaults to [0.7, 1.05] because
+    the eval-side mesh pipeline refits a rotated part's grown AABB back
+    into the unit cube — rotated eval parts are *smaller* by up to ~1/√3 —
+    and because it doubles as margin-shift (scale family) robustness.
+
+    Gather-heavy VPU work, roughly comparable to one small conv; classify
+    only (per-voxel targets would need the same resample with nearest
+    interpolation). Output stays float in [0, 1] (interpolated occupancy —
+    the model consumes float voxels either way).
+    """
+    b = voxels.shape[0]
+    while b % groups:
+        groups -= 1
+    D, H, W = voxels.shape[1:4]
+    keys = jax.random.split(rng, groups)
+    c = jnp.array([(D - 1) / 2.0, (H - 1) / 2.0, (W - 1) / 2.0])
+    grid = jnp.stack(
+        jnp.meshgrid(
+            jnp.arange(D, dtype=jnp.float32),
+            jnp.arange(H, dtype=jnp.float32),
+            jnp.arange(W, dtype=jnp.float32),
+            indexing="ij",
+        )
+    ).reshape(3, -1)  # [3, D*H*W]
+
+    def warp_group(vox, key):
+        kq, ks = jax.random.split(key)
+        rot = _quat_to_matrix(jax.random.normal(kq, (4,)))
+        s = jax.random.uniform(
+            ks, (), minval=scale_range[0], maxval=scale_range[1]
+        )
+        # Inverse map: output voxel p samples input at R^T (p - c)/s + c.
+        src = (rot.T @ (grid - c[:, None])) / s + c[:, None]
+
+        def sample_one(v):  # v: [D, H, W]
+            return jax.scipy.ndimage.map_coordinates(
+                v, [src[0], src[1], src[2]], order=1, mode="constant",
+                cval=0.0,
+            ).reshape(D, H, W)
+
+        # [n, D, H, W, C] → vmap over batch then channels.
+        return jax.vmap(
+            lambda g: jnp.stack(
+                [sample_one(g[..., ch]) for ch in range(g.shape[-1])],
+                axis=-1,
+            )
+        )(vox)
+
+    step = b // groups
+    parts = [
+        warp_group(voxels[i * step : (i + 1) * step], keys[i])
+        for i in range(groups)
+    ]
+    return jnp.concatenate(parts, axis=0)
+
+
 def random_rotate_batch(
     voxels: jnp.ndarray, rng: jax.Array, groups: int = 8
 ) -> jnp.ndarray:

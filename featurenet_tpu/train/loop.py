@@ -137,6 +137,7 @@ class Trainer:
             packed=packed,
             seg_loss=cfg.seg_loss,
             augment_noise=cfg.augment_noise,
+            augment_affine=cfg.augment_affine,
         )
         self._train_step = jax.jit(
             make_train_step(self.model, cfg.task, **step_kw),
@@ -300,6 +301,7 @@ class Trainer:
                         num_steps=n_steps,
                         seg_loss=cfg.seg_loss,
                         augment_noise=cfg.augment_noise,
+                        augment_affine=cfg.augment_affine,
                     ),
                     in_shardings=(self.state_sh, d_sh, d_sh, rep),
                     out_shardings=(self.state_sh, rep),

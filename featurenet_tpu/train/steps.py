@@ -123,6 +123,7 @@ def make_train_step(
     packed: bool = False,
     seg_loss: str = "balanced_ce",
     augment_noise: float = 0.0,
+    augment_affine: bool = False,
 ) -> Callable:
     """Build the pure train-step function (jit it with shardings at call site).
 
@@ -158,14 +159,13 @@ def make_train_step(
         dropout_rng, aug_rng, noise_rng = jax.random.split(step_rng, 3)
         voxels = _batch_voxels(batch, packed)
         target = batch[target_key]
-        if augment_noise > 0.0:
-            # Occupancy bit-flips (the OOD harness's noise model): XOR on
-            # the 0/1 grid, fused into the unpack — VPU-cheap.
-            flip = jax.random.bernoulli(
-                noise_rng, augment_noise, voxels.shape
-            )
-            voxels = jnp.abs(voxels - flip.astype(voxels.dtype))
-        if augment_groups:
+        if augment_affine and augment_groups:
+            if task != "classify":
+                raise ValueError("augment_affine supports classify only")
+            from featurenet_tpu.ops.augment import random_affine_batch
+
+            voxels = random_affine_batch(voxels, aug_rng, augment_groups)
+        elif augment_groups:
             from featurenet_tpu.ops.augment import (
                 random_rotate_batch_paired,
             )
@@ -176,6 +176,16 @@ def make_train_step(
             )
             if task == "segment":
                 target = rot_target
+        if augment_noise > 0.0:
+            # Occupancy bit-flips (the OOD harness's noise model), applied
+            # AFTER any pose/affine augmentation so the trained noise
+            # matches the harness's crisp bit-flips on the final grid
+            # (flips warped through the affine resample would attenuate
+            # into fractional blobs). XOR on the 0/1 grid — VPU-cheap.
+            flip = jax.random.bernoulli(
+                noise_rng, augment_noise, voxels.shape
+            )
+            voxels = jnp.abs(voxels - flip.astype(voxels.dtype))
         grads, (new_stats, metrics) = jax.grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, voxels, target,
             dropout_rng
@@ -196,6 +206,7 @@ def make_multi_train_step(
     seg_loss: str = "balanced_ce",
     num_steps: int = 2,
     augment_noise: float = 0.0,
+    augment_affine: bool = False,
 ) -> Callable:
     """``num_steps`` train steps fused into ONE XLA executable.
 
@@ -220,7 +231,7 @@ def make_multi_train_step(
     step = make_train_step(
         model, task, label_smoothing,
         augment_groups=augment_groups, packed=packed, seg_loss=seg_loss,
-        augment_noise=augment_noise,
+        augment_noise=augment_noise, augment_affine=augment_affine,
     )
 
     def multi_step(state: TrainState, batches, rng):
@@ -242,6 +253,7 @@ def make_hbm_multi_train_step(
     num_steps: int = 1,
     seg_loss: str = "balanced_ce",
     augment_noise: float = 0.0,
+    augment_affine: bool = False,
 ) -> Callable:
     """Train steps that SAMPLE THEIR BATCHES FROM HBM — zero per-step host
     traffic.
@@ -275,7 +287,7 @@ def make_hbm_multi_train_step(
     step = make_train_step(
         model, task, label_smoothing,
         augment_groups=augment_groups, packed=True, seg_loss=seg_loss,
-        augment_noise=augment_noise,
+        augment_noise=augment_noise, augment_affine=augment_affine,
     )
     data_axis = mesh.shape["data"]
     if global_batch % data_axis:

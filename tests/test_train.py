@@ -195,6 +195,13 @@ def test_hbm_cache_config_guards():
     with pytest.raises(ValueError, match="augment"):
         get_config("pod64", data_cache="x", hbm_cache=True,
                    augment_device=False)
+    # augment_affine without active device augmentation would be silently
+    # ignored (synthetic streaming / --no-augment) — must refuse.
+    with pytest.raises(ValueError, match="silently ignored"):
+        get_config("warp64", augment_affine=True)
+    # augment_noise is a probability, not a percentage.
+    with pytest.raises(ValueError, match="bit-flip"):
+        get_config("pod64", augment_noise=5.0)
 
 
 def test_eval_deterministic():
