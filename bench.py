@@ -87,8 +87,11 @@ def main() -> None:
         )
         e2e = {
             "e2e_samples_per_sec": plain["e2e_samples_per_sec"],
+            "e2e_spread_pct": plain["e2e_spread_pct"],
             "e2e_pipelined_samples_per_sec": piped["e2e_samples_per_sec"],
+            "e2e_pipelined_spread_pct": piped["e2e_spread_pct"],
             "e2e_hbm_samples_per_sec": hbm["e2e_samples_per_sec"],
+            "e2e_hbm_spread_pct": hbm["e2e_spread_pct"],
             "e2e_steps_per_dispatch": E2E_K,
             "e2e_pipeline_speedup": round(
                 piped["e2e_samples_per_sec"]

@@ -196,7 +196,9 @@ def measure_host_feed(cfg, batches: int = 50, warmup: int = 5) -> dict:
     }
 
 
-def measure_e2e(cfg, steps: int = 48, warmup: int = 16) -> dict:
+def measure_e2e(
+    cfg, steps: int = 48, warmup: int = 16, repeats: int = 2
+) -> dict:
     """Wall-clock end-to-end training rate through the Trainer's own path:
     host feed (cache gather + wire) → threaded prefetch → device_put →
     (possibly k-fused) dispatch → bounded-in-flight readback.
@@ -227,17 +229,28 @@ def measure_e2e(cfg, steps: int = 48, warmup: int = 16) -> dict:
         m = trainer.dispatch_group(stream, k)
     float(m["loss"])  # drain compile + pipeline fill
     groups = max(1, steps // k)
-    pending: list = []
-    t0 = time.perf_counter()
-    for _ in range(groups):
-        pending.append(trainer.dispatch_group(stream, k)["loss"])
-        if len(pending) > max(1, cfg.max_inflight_steps // k):
-            float(pending.pop(0))
-    for loss in pending:
-        float(loss)
-    dt = time.perf_counter() - t0
+
+    def walled() -> float:
+        pending: list = []
+        t0 = time.perf_counter()
+        for _ in range(groups):
+            pending.append(trainer.dispatch_group(stream, k)["loss"])
+            if len(pending) > max(1, cfg.max_inflight_steps // k):
+                float(pending.pop(0))
+        for loss in pending:
+            float(loss)
+        return time.perf_counter() - t0
+
+    # Best-of-repeats: a measurement window of only steps/k dispatch
+    # groups (6 at the defaults with k=8) puts one ~second-scale tunnel
+    # stall at 1/6 of the wall — a single window once measured a
+    # *pipelined* loop as slower than unpipelined. The best window is the
+    # honest sustained rate; spread is reported alongside.
+    walls = [walled() for _ in range(max(1, repeats))]
+    dt = min(walls)
     return {
         "e2e_samples_per_sec": round(groups * k * cfg.global_batch / dt, 1),
+        "e2e_spread_pct": round(100.0 * (max(walls) - dt) / dt, 1),
         "steps_per_dispatch": k,
         "steps": groups * k,
         "global_batch": cfg.global_batch,
