@@ -384,6 +384,13 @@ def seg64() -> Config:
     # (mean IoU 0.798 vs 0.790 at 10k steps, ahead at every mid-run eval —
     # BASELINE.md round-2 ablation), so it is the default. total_steps:
     # 10k — the 5k runs of both variants were still climbing ~0.01/1k.
+    # Round-4 levers are the default: axis-projection+coordinate input
+    # context (removed the through/blind family confusion outright) and a
+    # 2-block decoder — matched-budget arms measured 0.8092 → 0.8634 (A),
+    # 0.8537 (B), 0.8890 combined; the combined model's diagnosis shows
+    # zero remaining family-identity cost (BASELINE.md round 4). Note the
+    # combined model needs steps_per_dispatch=1 at batch 32 on a 16 GB
+    # chip (the 8-fused executable exceeds HBM by ~0.5 GB).
     return Config(
         name="seg64",
         task="segment",
@@ -393,6 +400,8 @@ def seg64() -> Config:
         total_steps=10000,
         peak_lr=5e-4,
         seg_loss="ce_dice",
+        seg_input_context="proj_coords",
+        seg_decoder_blocks=2,
     ).validate()
 
 

@@ -183,6 +183,19 @@ def test_hbm_resident_seg_training(tmp_path):
     assert np.isfinite(last["loss"])
 
 
+def test_measure_e2e_smoke():
+    """The e2e wall-clock benchmark runs the Trainer's own dispatch path
+    and returns a positive rate with in-artifact spread (CPU, tiny)."""
+    from featurenet_tpu.benchmark import measure_e2e
+
+    cfg = get_config("smoke16", global_batch=8, data_workers=1,
+                     eval_batches=1)
+    out = measure_e2e(cfg, steps=4, warmup=2, repeats=2)
+    assert out["e2e_samples_per_sec"] > 0
+    assert out["e2e_spread_pct"] >= 0
+    assert out["steps"] == 4 and not out["hbm_resident"]
+
+
 def test_hbm_cache_config_guards():
     """hbm_cache misconfiguration fails at validate time, not mid-run."""
     with pytest.raises(ValueError, match="data_cache"):
