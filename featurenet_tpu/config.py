@@ -379,6 +379,31 @@ def warp64() -> Config:
     ).validate()
 
 
+def sprint64() -> Config:
+    # warp64's successor (round 4): the round-3 profile named a 5³/s4 stem
+    # as the next lever (coverage stays complete, 5 > stride 4; ~⅔ of the
+    # stem's remaining FLOPs shaved) but skipped it because accuracy
+    # revalidation cost hours — HBM-resident retrains made it 12 minutes.
+    # Measured: 16,334 samples/sec/chip (spread 7.3%; warp64 14,428 same
+    # session) and 99.98% held-out (4,799/4,800) on the 24×1000 benchmark
+    # at this preset's full 8k budget — one validation run, vs warp64's
+    # three across two rounds; BASELINE.md round 4.
+    return Config(
+        name="sprint64",
+        resolution=64,
+        global_batch=256,
+        arch=dataclasses.replace(
+            FeatureNetArch(),
+            kernels=(5, 3, 3, 3),
+            strides=(4, 1, 1, 1),
+            pool_after=(False, False, False, True),
+        ),
+        total_steps=8000,
+        peak_lr=3e-4,
+        warmup_steps=200,
+    ).validate()
+
+
 def seg64() -> Config:
     # seg_loss: ce_dice beat balanced_ce in a matched-budget head-to-head
     # (mean IoU 0.798 vs 0.790 at 10k steps, ahead at every mid-run eval —
@@ -429,6 +454,7 @@ PRESETS = {
     "fast64": fast64,
     "turbo64": turbo64,
     "warp64": warp64,
+    "sprint64": sprint64,
     "seg64": seg64,
     "abc128": abc128,
 }

@@ -27,6 +27,9 @@ def ref_conv(x, w, stride=1):
     (9, 3, 3, 1, 4),    # stride 3, odd grid
     (8, 4, 2, 1, 4),    # even kernel
     (16, 7, 4, 1, 8),   # stride-4 stem (round-3 s4 flagship lever)
+    (16, 5, 4, 1, 8),   # 5^3/s4 sprint64 stem: pad_lo=0, even transformed
+                        # kernel with asymmetric padding — a distinct plan
+                        # branch from every k=7 case (round-4 flagship)
 ])
 def test_s2d_conv_matches_direct(rng, r, k, s, cin, cout):
     x = jnp.asarray(rng.standard_normal((2, r, r, r, cin)), jnp.float32)
