@@ -1,0 +1,174 @@
+"""Analytic HBM byte model for the compiled train step (the bytes sibling of
+``ops/flops.py``).
+
+Why this exists: the best seg64 model silently trained 8× slower than its
+dispatch amortization allowed — the 8-step fused executable exceeded HBM, the
+failure surfaced as a compile-time OOM, and the fix was an operator hand-edit
+to ``steps_per_dispatch=1`` (BASELINE.md round 4). ``max_feasible_k`` makes
+that decision analytic and automatic at Trainer build time, mirroring
+``parallel.mesh.clamp_model_axis``'s degrade-don't-crash pattern.
+
+Calibration (XLA's own ``compiled.memory_analysis()`` on the real TPU v5e,
+round 5 — the probe lowers the HBM-resident fused step with abstract args):
+
+| config  | batch | k | temp bytes | args bytes |
+|---|---|---|---|---|
+| seg64 (combined) | 32 | 1 | 13.16 G | 1.185 G |
+| seg64 | 32 | 2 | 14.70 G | 1.185 G |
+| seg64 | 32 | 4 | 16.80 G | 1.185 G |
+| seg64 | 32 | 8 | compile refused (remote helper OOM) | — |
+| warp64 | 256 | 1 | 1.267 G | 0.685 G |
+| warp64 | 256 | 8 | 1.817 G | 0.685 G |
+| sprint64 | 256 | 8 | 1.820 G | 0.685 G |
+
+Two facts drive the model: (1) per-step activation peak dominates temp at
+k=1; (2) XLA retains roughly 6–12 % of that peak per additional fused step
+(seg 0.092, warp 0.062 measured) — fused steps are sequenced, but buffer
+assignment still overlaps across step boundaries. Coefficients below are
+fit so the analytic seg64 k=1 activation estimate lands within ~5 % of the
+measured 13.16 G; the per-step retention uses the conservative end (0.12).
+
+This is a FIRST-ORDER model: it exists to pick ``k``, not to replace the
+compiler's buffer assignment. ``k=1`` is always allowed (the proven
+fallback); the question the model answers is whether ``k>1`` is safe.
+"""
+
+from __future__ import annotations
+
+import math
+
+# TPU v5e: 16 GB HBM, of which XLA reported 15.75 G usable in the seg64
+# compile-OOM incident message (BASELINE.md round 4).
+HBM_BYTES = 15.75e9
+# Reject k>1 unless the estimate fits in this fraction of the budget —
+# absorbs the model's first-order error (measured within ~±10 % on the
+# calibration points, headroom for shapes it has not seen).
+SAFETY = 0.85
+# Live tensors per ConvBNRelu block, in units of the block's output size at
+# bf16: conv out (pre-BN, kept for the BN backward), BN/relu out (kept for
+# the next conv's backward), plus BN-stat and fusion residue. Fit to the
+# seg64 k=1 measurement (2.5 from first principles underestimated by ~20 %).
+CONV_BLOCK_TENSORS = 3.2
+# Fraction of the per-step activation peak XLA retains per extra fused step.
+FUSED_STEP_RETENTION = 0.12
+
+
+def state_bytes(params_n: int, optimizer: str = "adamw") -> int:
+    """Persistent training-state bytes: fp32 params + optimizer slots +
+    the gradient tree live during the update."""
+    slots = {"adamw": 2, "adam": 2, "sgd": 1}.get(optimizer, 2)
+    return int(params_n * 4 * (2 + slots))  # params + grads + slots
+
+
+def wire_batch_bytes(cfg) -> int:
+    """One bit-packed wire batch (what each fused step holds as input)."""
+    b, r = cfg.global_batch, cfg.resolution
+    vox = b * r * r * (r // 8)  # uint8 packed
+    tgt = b * r * r * r if cfg.task == "segment" else b * 4
+    return vox + tgt
+
+
+def resident_split_bytes(cfg, n_rows: int) -> int:
+    """The HBM-resident packed train split (hbm_cache mode)."""
+    if not n_rows:
+        return 0
+    r = cfg.resolution
+    vox = n_rows * r * r * (r // 8)
+    tgt = n_rows * r * r * r if cfg.task == "segment" else n_rows * 4
+    return vox + tgt
+
+
+def classifier_act_bytes_per_sample(arch, resolution: int) -> int:
+    """Per-sample activation bytes of one FeatureNet train step (bf16
+    conv stack + fp32 input/loss edges), the same walk as
+    ``flops.classifier_forward_flops``."""
+    total = 4 * resolution**3  # unpacked fp32 input
+    d, c_in = resolution, 1
+    for f, s, p in zip(arch.features, arch.strides, arch.pool_after):
+        d = math.ceil(d / s)
+        total += int(CONV_BLOCK_TENSORS * 2 * f * d**3)
+        if p:
+            d //= 2
+        c_in = f
+    flat = arch.features[-1] if arch.head_gap else arch.features[-1] * d**3
+    # Dense-land: flatten/GAP out, hidden (+ dropout mask), logits + softmax.
+    total += 4 * flat + 3 * 4 * arch.hidden + 3 * 4 * arch.num_classes
+    return total
+
+
+def segmenter_act_bytes_per_sample(
+    features, resolution: int, num_classes: int,
+    input_context: str = "none", decoder_blocks: int = 1,
+    bottleneck_blocks: int = 1,
+) -> int:
+    """Per-sample activation bytes of one U-Net segmenter train step,
+    walking encoder/bottleneck/decoder exactly as ``FeatureNetSegmenter``
+    composes them (models/segmenter.py)."""
+    R = resolution
+    in_ch = {"none": 1, "proj": 4, "proj_coords": 7}[input_context]
+    total = 4 * R**3 + 2 * in_ch * R**3  # fp32 input + bf16 concat
+    blk = CONV_BLOCK_TENSORS * 2  # bytes per (channel · voxel) per block
+
+    d = R
+    for f in features:
+        total += int(blk * f * d**3)  # refine (also the saved skip)
+        d //= 2
+        total += int(blk * f * d**3)  # strided downsample
+    for _ in range(bottleneck_blocks):
+        total += int(blk * features[-1] * 2 * d**3)
+    for f in reversed(features):
+        d *= 2
+        total += 2 * f * d**3  # transposed-conv out
+        total += 2 * 2 * f * d**3  # skip concat
+        total += int(blk * f * d**3) * decoder_blocks
+    # Loss land at fp32 over num_classes+1 channels: logits, softmax probs,
+    # one-hot target, per-voxel CE (ce_dice keeps probs and one-hot live
+    # through the Dice reduction's backward).
+    total += 3 * 4 * (num_classes + 1) * R**3 + 4 * R**3
+    return total
+
+
+def act_bytes_per_sample(cfg) -> int:
+    if cfg.task == "segment":
+        from featurenet_tpu.data.synthetic import NUM_CLASSES
+
+        return segmenter_act_bytes_per_sample(
+            tuple(cfg.seg_features), cfg.resolution, NUM_CLASSES,
+            cfg.seg_input_context, cfg.seg_decoder_blocks,
+            cfg.seg_bottleneck_blocks,
+        )
+    act = classifier_act_bytes_per_sample(cfg.arch, cfg.resolution)
+    if cfg.augment_affine:
+        # Trilinear resample temporaries: source-coordinate grid + warped
+        # fp32 output + gather intermediates (~3 input-size fp32 tensors).
+        act += 3 * 4 * cfg.resolution**3
+    return act
+
+
+def fused_step_bytes(cfg, k: int, params_n: int, n_rows: int = 0) -> int:
+    """Estimated peak HBM bytes of the k-fused train-step executable."""
+    act = act_bytes_per_sample(cfg) * cfg.global_batch
+    temp = int(act * (1.0 + FUSED_STEP_RETENTION * (k - 1)))
+    return (
+        state_bytes(params_n, cfg.optimizer)
+        + resident_split_bytes(cfg, n_rows)
+        + k * wire_batch_bytes(cfg)
+        + temp
+    )
+
+
+def max_feasible_k(
+    cfg, params_n: int, n_rows: int = 0, budget: float | None = None,
+    requested: int | None = None,
+) -> int:
+    """Largest ``steps_per_dispatch`` ≤ ``requested`` whose estimated fused
+    executable fits ``SAFETY × budget``. ``k=1`` is always allowed: it is
+    the incident-proven fallback, and refusing to train at all on a model
+    estimate would be worse than trusting the compiler's own OOM error."""
+    if budget is None:
+        budget = HBM_BYTES  # late-bound so tests can shrink the budget
+    want = cfg.steps_per_dispatch if requested is None else requested
+    k = max(1, want)
+    while k > 1 and fused_step_bytes(cfg, k, params_n, n_rows) > SAFETY * budget:
+        k -= 1
+    return k

@@ -51,6 +51,27 @@ from featurenet_tpu.train.steps import (
 from featurenet_tpu.utils.logging import MetricLogger
 
 
+def _hbm_rows_estimate(cfg: Config) -> int:
+    """Train-split row count that ``hbm_cache`` mode will hold resident —
+    read from the cache's index metadata (cheap; the dataset itself is not
+    built yet when the dispatch-k clamp needs this)."""
+    if not (cfg.hbm_cache and cfg.data_cache):
+        return 0
+    import json
+    import os
+
+    try:
+        with open(os.path.join(cfg.data_cache, "index.json")) as fh:
+            index = json.load(fh)
+        if index.get("kind") == "segment":
+            total = sum(s["count"] for s in index["shards"])
+        else:
+            total = sum(index["counts"].values())
+        return int(total * (1.0 - cfg.test_fraction))
+    except (OSError, KeyError, ValueError):
+        return 0  # the Trainer's own cache open will raise the real error
+
+
 def build_model(cfg: Config):
     if cfg.task == "segment":
         return FeatureNetSegmenter(
@@ -116,6 +137,25 @@ class Trainer:
         )(rng)
         self.params_n = param_count(self.state.params)
 
+        # Warm start (fine-tune semantics): params + batch_stats from an
+        # existing checkpoint, step 0 and fresh optimizer slots. A resume
+        # from checkpoint_dir still wins (resume_if_available overwrites),
+        # so supervised fine-tune runs restart correctly.
+        if cfg.init_from:
+            from featurenet_tpu.train.checkpoint import (
+                CheckpointManager as _CM,
+                load_run_config,
+            )
+
+            saved = load_run_config(cfg.init_from)
+            if saved is not None:
+                from featurenet_tpu.config import check_identity
+
+                check_identity(saved, cfg)
+            src = _CM(cfg.init_from)
+            self.state = src.restore_init(self.state)
+            src.close()
+
         # --- compiled steps -------------------------------------------------
         # Wire format: voxels travel bit-packed for both tasks (unpacked on
         # device inside the step); classify drops the per-voxel target,
@@ -138,6 +178,13 @@ class Trainer:
             seg_loss=cfg.seg_loss,
             augment_noise=cfg.augment_noise,
             augment_affine=cfg.augment_affine,
+            affine_opts=dict(
+                prob=cfg.augment_affine_prob,
+                ramp_steps=cfg.augment_ramp_steps,
+                rotate=cfg.augment_affine_rotate,
+                scale_range=cfg.augment_scale_range,
+                translate_vox=cfg.augment_translate_vox,
+            ),
         )
         self._train_step = jax.jit(
             make_train_step(self.model, cfg.task, **step_kw),
@@ -149,7 +196,29 @@ class Trainer:
         # dispatches once per k optimizer updates (bitwise-identical math,
         # see make_multi_train_step). The single-step jit above stays for
         # segment remainders (total % k) and as the k=1 path.
+        # The requested k is clamped against the analytic HBM byte model
+        # (ops/membytes.py): the k-fused executable's peak grows ~linearly
+        # with k, and the best seg64 model once lost 8× of its dispatch
+        # amortization to a hand-resolved compile-time OOM. Degrade with a
+        # warning — never crash, never silently under-dispatch.
         self._k = max(1, cfg.steps_per_dispatch)
+        if self._k > 1:
+            from featurenet_tpu.ops.membytes import max_feasible_k
+
+            k_fit = max_feasible_k(
+                cfg, self.params_n, n_rows=_hbm_rows_estimate(cfg)
+            )
+            if k_fit < self._k:
+                import json as _json
+                import sys as _sys
+
+                print(_json.dumps({
+                    "dispatch_warning": f"steps_per_dispatch="
+                    f"{cfg.steps_per_dispatch} does not fit the analytic "
+                    f"HBM byte model for this config; clamped to {k_fit} "
+                    "(ops/membytes.max_feasible_k)",
+                }), file=_sys.stderr)
+                self._k = k_fit
         if self._k > 1:
             self._multi_step = jax.jit(
                 make_multi_train_step(
@@ -302,6 +371,7 @@ class Trainer:
                         seg_loss=cfg.seg_loss,
                         augment_noise=cfg.augment_noise,
                         augment_affine=cfg.augment_affine,
+                        affine_opts=step_kw["affine_opts"],
                     ),
                     in_shardings=(self.state_sh, d_sh, d_sh, rep),
                     out_shardings=(self.state_sh, rep),
@@ -489,6 +559,12 @@ class Trainer:
                     self._heartbeat()
                 step = new_step
         finally:
+            if stream is not None:
+                # Stop the producer threads and release their lookahead of
+                # device_put batches — a returned run must not keep pinning
+                # HBM or host cycles (benchmarks run several Trainers in
+                # one process).
+                stream.close()
             if trace_active:
                 # An exception mid-window must not lose the trace of the
                 # failing steps (the ones worth inspecting).
