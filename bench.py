@@ -79,21 +79,26 @@ def main() -> None:
     if os.path.isdir(E2E_CACHE):
         kw = dict(data_cache=E2E_CACHE, data_workers=1,
                   checkpoint_dir=None, heartbeat_file=None)
-        plain = measure_e2e(get_config("warp64", **kw))
+        # e2e rows measure the FLAGSHIP arch (round-4 verdict: the artifact's
+        # headline arch had no end-to-end number of record); one warp64
+        # HBM row rides along for cross-round comparability with the
+        # round-3/4 wall-clock study in BASELINE.md.
+        plain = measure_e2e(get_config("sprint64", **kw))
         piped = measure_e2e(
-            get_config("warp64", steps_per_dispatch=E2E_K, **kw)
+            get_config("sprint64", steps_per_dispatch=E2E_K, **kw)
         )
         hbm = measure_e2e(
+            get_config("sprint64", hbm_cache=True,
+                       steps_per_dispatch=E2E_K, **kw),
+            steps=96,
+        )
+        warp_hbm = measure_e2e(
             get_config("warp64", hbm_cache=True,
                        steps_per_dispatch=E2E_K, **kw),
             steps=96,
         )
         e2e = {
-            # e2e rows are measured on warp64 (not the sprint64 flagship)
-            # for cross-round comparability with the round-3/4 wall-clock
-            # study in BASELINE.md — labeled so the artifact can't silently
-            # mix architectures.
-            "e2e_arch": "warp64",
+            "e2e_arch": "sprint64",
             "e2e_samples_per_sec": plain["e2e_samples_per_sec"],
             "e2e_spread_pct": plain["e2e_spread_pct"],
             "e2e_pipelined_samples_per_sec": piped["e2e_samples_per_sec"],
@@ -111,9 +116,25 @@ def main() -> None:
                 hbm["e2e_samples_per_sec"]
                 / max(plain["e2e_samples_per_sec"], 1e-9), 2
             ),
+            "e2e_warp64_hbm_samples_per_sec":
+                warp_hbm["e2e_samples_per_sec"],
+            "e2e_warp64_hbm_spread_pct": warp_hbm["e2e_spread_pct"],
         }
     print(json.dumps({
         "metric": "featurenet64_train_throughput",
+        # Schema 2 (round 5): the SLOPE-TIMED spread fields (spread_pct,
+        # serving_spread_pct, warp64/paper_arch spread_pct) are best-two-
+        # slope agreement under the shared converged protocol (benchmark.
+        # _converged_slope) with *_minmax_pct carrying the full draw
+        # range, and slope headlines quote the mean of the two agreeing
+        # best draws, not the min. The e2e_*_spread_pct family is a
+        # different measurement (whole wall-clock windows through the
+        # Trainer's dispatch path, best-of-2) and stays (max-min)/min —
+        # see measure_e2e. r01–r03 spread_pct was (max-min)/min over
+        # fixed short windows; r04 mixed conventions (serving converged,
+        # train fixed-window) under one key — the round-5 advisor finding
+        # this field resolves.
+        "bench_schema": 2,
         "value": flag["samples_per_sec_per_chip"],
         "unit": "samples/sec/chip",
         "vs_baseline": round(
@@ -123,6 +144,7 @@ def main() -> None:
                 "held-out 99.98%)",
         "repeats": flag["repeats"],
         "spread_pct": flag["spread_pct"],
+        "spread_minmax_pct": flag["spread_minmax_pct"],
         "load_avg_1m": float(os.getloadavg()[0]),
         "load_avg_1m_at_invoke": round(load_at_invoke, 2),
         "gflops_per_sample": flag["gflops_per_sample"],

@@ -107,7 +107,14 @@ def dilate(g: np.ndarray) -> np.ndarray:
 
 
 def erode(g: np.ndarray) -> np.ndarray:
-    """One-voxel 6-neighborhood binary erosion (zero boundary)."""
+    """One-voxel 6-neighborhood binary erosion.
+
+    Boundary convention: implemented as ``~dilate(~g)`` with ``dilate``'s
+    zero-padded shifts, so out-of-grid is treated as SOLID — a voxel on
+    the grid boundary is never eroded from the outside. Harmless for this
+    harness's margin-normalized parts (the stock never touches the grid
+    edge), but an asymmetry vs ``dilate``'s zero boundary that a
+    non-margined input would feel as silent under-erosion."""
     return ~dilate(~g)
 
 
