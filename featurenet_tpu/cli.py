@@ -47,7 +47,30 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    dest="augment_affine",
                    help="arbitrary-angle SO(3)+scale augmentation on "
                         "device (OOD-robust training; replaces cube-group "
-                        "rotation; classify only)")
+                        "rotation; segment warps targets jointly)")
+    p.add_argument("--augment-affine-prob", type=float,
+                   dest="augment_affine_prob",
+                   help="per-group probability the affine warp applies "
+                        "(clean/affine batch mixing; default 1.0)")
+    p.add_argument("--augment-ramp-steps", type=int,
+                   dest="augment_ramp_steps",
+                   help="ramp the affine probability linearly 0->prob over "
+                        "this many steps (default 0 = no ramp)")
+    p.add_argument("--no-augment-affine-rotate", action="store_true",
+                   dest="no_augment_affine_rotate",
+                   help="affine without rotation: scale+translate only "
+                        "(parameter-extrapolation augmentation)")
+    p.add_argument("--augment-scale-range", type=float, nargs=2,
+                   dest="augment_scale_range", metavar=("LO", "HI"),
+                   help="uniform scale window for the affine warp "
+                        "(default 0.7 1.05)")
+    p.add_argument("--augment-translate-vox", type=float,
+                   dest="augment_translate_vox",
+                   help="uniform per-axis translation draw in voxels for "
+                        "the affine warp (default 0)")
+    p.add_argument("--init-from", dest="init_from",
+                   help="warm-start params+batch_stats from this checkpoint "
+                        "dir (step and optimizer state start fresh)")
     p.add_argument("--augment-noise", type=float, dest="augment_noise",
                    help="train-time occupancy bit-flip rate (robustness "
                         "augmentation, applied on device; 0 = off)")
@@ -118,7 +141,8 @@ def _overrides(args) -> dict:
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
-        "augment_noise",
+        "augment_noise", "augment_affine_prob", "augment_ramp_steps",
+        "augment_translate_vox", "init_from",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
@@ -126,6 +150,10 @@ def _overrides(args) -> dict:
         for k in keys
         if getattr(args, k, None) is not None
     }
+    if getattr(args, "augment_scale_range", None) is not None:
+        out["augment_scale_range"] = tuple(args.augment_scale_range)
+    if getattr(args, "no_augment_affine_rotate", False):
+        out["augment_affine_rotate"] = False
     if getattr(args, "no_augment", False):
         out["augment"] = False
     if getattr(args, "hbm_cache", False):

@@ -56,11 +56,28 @@ class Config:
     # accuracy points.
     augment_noise: float = 0.0
     # Arbitrary-angle SO(3) rotation + uniform scale resampling inside the
-    # compiled step (ops/augment.random_affine_batch) — replaces the
-    # cube-group rotation when on. The OOD-robustness training mode:
-    # infinite pose diversity (a statically rotated cache overfits),
-    # classify only.
+    # compiled step (ops/augment.random_affine_batch_paired) — replaces
+    # the cube-group rotation when on. The OOD-robustness training mode:
+    # infinite pose diversity (a statically rotated cache overfits).
+    # Segmentation warps the per-voxel target with shared transforms
+    # (nearest-neighbor).
     augment_affine: bool = False
+    # Robust-recipe knobs (round 5, BASELINE.md "robust64"): per-group
+    # probability the warp applies (clean/affine batch mixing — the rest
+    # of the batch stays on the normalized serving distribution); a linear
+    # 0→prob ramp over the first augment_ramp_steps; rotation toggle
+    # (off = scale+translate only, the parameter-extrapolation mode);
+    # scale window; uniform per-axis translation draw in voxels.
+    augment_affine_prob: float = 1.0
+    augment_ramp_steps: int = 0
+    augment_affine_rotate: bool = True
+    augment_scale_range: tuple[float, float] = (0.7, 1.05)
+    augment_translate_vox: float = 0.0
+    # Warm start: load params + batch_stats (NOT step / optimizer state)
+    # from this checkpoint directory at init — fine-tune semantics. A
+    # checkpoint in checkpoint_dir still wins (resume beats warm start, so
+    # supervised fine-tune runs restart correctly).
+    init_from: Optional[str] = None
 
     # Model.
     arch: FeatureNetArch = dataclasses.field(default_factory=FeatureNetArch)
@@ -222,10 +239,46 @@ class Config:
                     "augment=True would otherwise be silently ignored — "
                     "pass augment=False to train unaugmented"
                 )
-        if self.augment_affine and self.task != "classify":
+        if not self.augment_affine:
+            # Knobs of a disabled mechanism must not parse-and-ignore (the
+            # same refusal convention as the hbm/augment guards below).
+            non_default = [
+                n for n, d in (
+                    ("augment_affine_prob", 1.0),
+                    ("augment_ramp_steps", 0),
+                    ("augment_affine_rotate", True),
+                    ("augment_scale_range", (0.7, 1.05)),
+                    ("augment_translate_vox", 0.0),
+                ) if getattr(self, n) != d
+            ]
+            if non_default:
+                raise ValueError(
+                    f"{', '.join(non_default)} configured but "
+                    "augment_affine is off — the knobs would be silently "
+                    "ignored; pass augment_affine=True (--augment-affine)"
+                )
+        if not (0.0 < self.augment_affine_prob <= 1.0):
             raise ValueError(
-                "augment_affine supports task='classify' only (per-voxel "
-                "targets would need the same resample)"
+                f"augment_affine_prob is a per-group probability in "
+                f"(0, 1]; got {self.augment_affine_prob}"
+            )
+        if self.augment_ramp_steps < 0:
+            raise ValueError("augment_ramp_steps must be >= 0")
+        if self.augment_translate_vox < 0:
+            raise ValueError("augment_translate_vox must be >= 0 voxels")
+        lo, hi = self.augment_scale_range
+        if not (0.0 < lo <= hi):
+            raise ValueError(
+                f"augment_scale_range must satisfy 0 < lo <= hi; got "
+                f"({lo}, {hi})"
+            )
+        if self.augment_affine and not self.augment_affine_rotate \
+                and self.augment_scale_range == (1.0, 1.0) \
+                and self.augment_translate_vox == 0.0:
+            raise ValueError(
+                "augment_affine with rotation off, scale (1,1), and "
+                "translate 0 is the identity — disable augment_affine "
+                "instead of paying the resample for nothing"
             )
         if self.augment_affine and not self.device_augment:
             raise ValueError(
@@ -503,6 +556,8 @@ def config_from_dict(d: dict) -> Config:
         })
     if "seg_features" in kw:
         kw["seg_features"] = tuple(kw["seg_features"])
+    if "augment_scale_range" in kw:
+        kw["augment_scale_range"] = tuple(kw["augment_scale_range"])
     return Config(**kw).validate()
 
 

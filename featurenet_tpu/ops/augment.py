@@ -78,7 +78,26 @@ def random_affine_batch(
     groups: int = 8,
     scale_range: tuple[float, float] = (0.7, 1.05),
 ) -> jnp.ndarray:
-    """Arbitrary-angle SO(3) rotation + uniform scale, inside the step.
+    """SO(3) rotation + uniform scale, inside the step (classify wrapper
+    over ``random_affine_batch_paired`` — see there for the full story)."""
+    return random_affine_batch_paired(
+        voxels, None, rng, groups=groups, scale_range=scale_range
+    )[0]
+
+
+def random_affine_batch_paired(
+    voxels: jnp.ndarray,
+    seg: jnp.ndarray | None,
+    rng: jax.Array,
+    groups: int = 8,
+    scale_range: tuple[float, float] = (0.7, 1.05),
+    rotate: bool = True,
+    translate_vox: float = 0.0,
+    prob=1.0,
+) -> tuple[jnp.ndarray, jnp.ndarray | None]:
+    """Arbitrary-angle SO(3) rotation + uniform scale + translation, inside
+    the compiled step, optionally warping a per-voxel target with SHARED
+    transforms.
 
     The cube group (``random_rotate_batch``) covers only the 24 axis-
     aligned poses; round 4's OOD harness measured the flagship collapsing
@@ -86,17 +105,31 @@ def random_affine_batch(
     training cache (one pose per part) overfits instead of generalizing —
     pose diversity must be infinite, i.e. drawn per step on device. Each
     batch group gets one random rotation (uniform SO(3) via quaternion)
-    composed with one uniform scale draw; voxels are trilinearly resampled
-    (``jax.scipy.ndimage.map_coordinates``) through the inverse affine
-    about the grid center. The scale range defaults to [0.7, 1.05] because
-    the eval-side mesh pipeline refits a rotated part's grown AABB back
-    into the unit cube — rotated eval parts are *smaller* by up to ~1/√3 —
-    and because it doubles as margin-shift (scale family) robustness.
+    composed with one uniform scale and translation draw; voxels are
+    trilinearly resampled (``jax.scipy.ndimage.map_coordinates``) through
+    the inverse affine about the grid center. The scale range defaults to
+    [0.7, 1.05] because the eval-side mesh pipeline refits a rotated
+    part's grown AABB back into the unit cube — rotated eval parts are
+    *smaller* by up to ~1/√3 — and because it doubles as margin-shift
+    (scale family) robustness.
 
-    Gather-heavy VPU work, roughly comparable to one small conv; classify
-    only (per-voxel targets would need the same resample with nearest
-    interpolation). Output stays float in [0, 1] (interpolated occupancy —
-    the model consumes float voxels either way).
+    Round-5 levers (the robust64 recipe search — BASELINE.md):
+    - ``prob``: per-group probability of applying the warp (clean/affine
+      batch mixing — the rest of the group passes through untouched,
+      matching the normalized serving distribution). May be a traced
+      scalar, so the Trainer can ramp it over the schedule.
+    - ``rotate=False``: scale+translate only — parameter-extrapolation
+      augmentation (feature size/position jitter) without buying the much
+      harder rotation-invariance problem.
+    - ``translate_vox``: uniform per-axis translation draw in [-t, +t]
+      voxels (position extrapolation; 0 disables).
+    - ``seg``: ``[B, D, H, W]`` integer per-voxel target warped with the
+      SAME group transforms, nearest-neighbor (order-0) resampled so
+      labels never blend.
+
+    Gather-heavy VPU work, roughly comparable to one small conv. Voxels
+    stay float in [0, 1] (interpolated occupancy — the model consumes
+    float voxels either way).
     """
     b = voxels.shape[0]
     while b % groups:
@@ -113,35 +146,72 @@ def random_affine_batch(
         )
     ).reshape(3, -1)  # [3, D*H*W]
 
-    def warp_group(vox, key):
-        kq, ks = jax.random.split(key)
-        rot = _quat_to_matrix(jax.random.normal(kq, (4,)))
+    def src_coords(key):
+        kq, ks, kt = jax.random.split(key, 3)
         s = jax.random.uniform(
             ks, (), minval=scale_range[0], maxval=scale_range[1]
         )
-        # Inverse map: output voxel p samples input at R^T (p - c)/s + c.
-        src = (rot.T @ (grid - c[:, None])) / s + c[:, None]
+        t = (
+            jax.random.uniform(
+                kt, (3,), minval=-translate_vox, maxval=translate_vox
+            )
+            if translate_vox > 0.0
+            else jnp.zeros(3)
+        )
+        # Inverse map: output voxel p samples input at
+        # R^T (p - c - t) / s + c.
+        shifted = (grid - (c + t)[:, None]) / s
+        if rotate:
+            rot = _quat_to_matrix(jax.random.normal(kq, (4,)))
+            shifted = rot.T @ shifted
+        return shifted + c[:, None]
 
-        def sample_one(v):  # v: [D, H, W]
+    def warp_group(vox, seg_g, key):
+        kc, kp = jax.random.split(key)
+        src = src_coords(kc)
+
+        def sample_one(v, order):  # v: [D, H, W]
             return jax.scipy.ndimage.map_coordinates(
-                v, [src[0], src[1], src[2]], order=1, mode="constant",
+                v, [src[0], src[1], src[2]], order=order, mode="constant",
                 cval=0.0,
             ).reshape(D, H, W)
 
-        # [n, D, H, W, C] → vmap over batch then channels.
-        return jax.vmap(
-            lambda g: jnp.stack(
-                [sample_one(g[..., ch]) for ch in range(g.shape[-1])],
-                axis=-1,
-            )
-        )(vox)
+        def apply(args):
+            vox, seg_g = args
+            # [n, D, H, W, C] → vmap over batch then channels.
+            warped = jax.vmap(
+                lambda g: jnp.stack(
+                    [sample_one(g[..., ch], 1) for ch in range(g.shape[-1])],
+                    axis=-1,
+                )
+            )(vox)
+            if seg_g is None:
+                return warped, None
+            # Nearest-neighbor for labels: order-0 gather, exact values.
+            wseg = jax.vmap(
+                lambda g: sample_one(g.astype(jnp.float32), 0)
+            )(seg_g).astype(seg_g.dtype)
+            return warped, wseg
+
+        take = jax.random.bernoulli(kp, prob)
+        return jax.lax.cond(
+            take, apply, lambda args: args, (vox, seg_g)
+        )
 
     step = b // groups
-    parts = [
-        warp_group(voxels[i * step : (i + 1) * step], keys[i])
-        for i in range(groups)
-    ]
-    return jnp.concatenate(parts, axis=0)
+    vox_parts, seg_parts = [], []
+    for i in range(groups):
+        sl = slice(i * step, (i + 1) * step)
+        v, s = warp_group(
+            voxels[sl], None if seg is None else seg[sl], keys[i]
+        )
+        vox_parts.append(v)
+        seg_parts.append(s)
+    out_vox = jnp.concatenate(vox_parts, axis=0)
+    out_seg = (
+        None if seg is None else jnp.concatenate(seg_parts, axis=0)
+    )
+    return out_vox, out_seg
 
 
 def random_rotate_batch(

@@ -83,6 +83,19 @@ class CheckpointManager:
         restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
         return state.replace(**restored)
 
+    def restore_init(
+        self, state: TrainState, step: Optional[int] = None
+    ) -> TrainState:
+        """Warm-start restore: take params + batch_stats from the
+        checkpoint but keep the live state's step (0) and fresh optimizer
+        slots — fine-tune semantics (the robust64 recipe's warm-start arm,
+        BASELINE.md round 5). Requires the live optimizer's state tree to
+        match the saved run's (same optimizer family)."""
+        restored = self.restore(state, step)
+        return state.replace(
+            params=restored.params, batch_stats=restored.batch_stats
+        )
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 

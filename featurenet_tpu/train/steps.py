@@ -124,6 +124,7 @@ def make_train_step(
     seg_loss: str = "balanced_ce",
     augment_noise: float = 0.0,
     augment_affine: bool = False,
+    affine_opts: dict | None = None,
 ) -> Callable:
     """Build the pure train-step function (jit it with shardings at call site).
 
@@ -131,11 +132,20 @@ def make_train_step(
     (ops/augment.py) inside the compiled step: classification rotates the
     voxels (the label is pose-invariant); segmentation rotates voxels and
     the per-voxel target jointly with shared group elements
-    (``random_rotate_batch_paired``). ``packed=True`` expects bit-packed
-    wire voxels and unpacks them on device.
+    (``random_rotate_batch_paired``). ``augment_affine`` replaces the cube
+    group with the continuous affine warp (``random_affine_batch_paired``;
+    per-voxel targets resample nearest-neighbor with shared transforms);
+    ``affine_opts`` carries its knobs — ``scale_range``, ``rotate``,
+    ``translate_vox``, ``prob``, and ``ramp_steps`` (prob ramps linearly
+    from 0 over this many steps, keyed off ``state.step``).
+    ``packed=True`` expects bit-packed wire voxels and unpacks them on
+    device.
     """
 
     target_key = "label" if task == "classify" else "seg"
+    aff = dict(scale_range=(0.7, 1.05), rotate=True, translate_vox=0.0,
+               prob=1.0, ramp_steps=0)
+    aff.update(affine_opts or {})
 
     def loss_fn(params, batch_stats, voxels, target, dropout_rng):
         out, mutated = model.apply(
@@ -160,11 +170,27 @@ def make_train_step(
         voxels = _batch_voxels(batch, packed)
         target = batch[target_key]
         if augment_affine and augment_groups:
-            if task != "classify":
-                raise ValueError("augment_affine supports classify only")
-            from featurenet_tpu.ops.augment import random_affine_batch
+            from featurenet_tpu.ops.augment import (
+                random_affine_batch_paired,
+            )
 
-            voxels = random_affine_batch(voxels, aug_rng, augment_groups)
+            prob = aff["prob"]
+            if aff["ramp_steps"] > 0:
+                # Linear warm-in: clean batches early (fast clean
+                # convergence), full augmentation pressure by ramp_steps.
+                prob = prob * jnp.clip(
+                    state.step / aff["ramp_steps"], 0.0, 1.0
+                )
+            voxels, aff_target = random_affine_batch_paired(
+                voxels, target if task == "segment" else None,
+                aug_rng, augment_groups,
+                scale_range=tuple(aff["scale_range"]),
+                rotate=aff["rotate"],
+                translate_vox=aff["translate_vox"],
+                prob=prob,
+            )
+            if task == "segment":
+                target = aff_target
         elif augment_groups:
             from featurenet_tpu.ops.augment import (
                 random_rotate_batch_paired,
@@ -207,6 +233,7 @@ def make_multi_train_step(
     num_steps: int = 2,
     augment_noise: float = 0.0,
     augment_affine: bool = False,
+    affine_opts: dict | None = None,
 ) -> Callable:
     """``num_steps`` train steps fused into ONE XLA executable.
 
@@ -232,6 +259,7 @@ def make_multi_train_step(
         model, task, label_smoothing,
         augment_groups=augment_groups, packed=packed, seg_loss=seg_loss,
         augment_noise=augment_noise, augment_affine=augment_affine,
+        affine_opts=affine_opts,
     )
 
     def multi_step(state: TrainState, batches, rng):
@@ -254,6 +282,7 @@ def make_hbm_multi_train_step(
     seg_loss: str = "balanced_ce",
     augment_noise: float = 0.0,
     augment_affine: bool = False,
+    affine_opts: dict | None = None,
 ) -> Callable:
     """Train steps that SAMPLE THEIR BATCHES FROM HBM — zero per-step host
     traffic.
@@ -288,6 +317,7 @@ def make_hbm_multi_train_step(
         model, task, label_smoothing,
         augment_groups=augment_groups, packed=True, seg_loss=seg_loss,
         augment_noise=augment_noise, augment_affine=augment_affine,
+        affine_opts=affine_opts,
     )
     data_axis = mesh.shape["data"]
     if global_batch % data_axis:
