@@ -267,6 +267,10 @@ def main(argv=None) -> None:
                                 "perturbation (featurenet_tpu.ood)")
     p_ood.add_argument("--checkpoint-dir", required=True)
     p_ood.add_argument("--per-class", type=int, default=50)
+    p_ood.add_argument("--seg-parts", type=int, default=60,
+                       help="segment checkpoints: fresh draws per row "
+                            "(the task is auto-detected from the "
+                            "checkpoint's persisted config)")
     p_ood.add_argument("--seed", type=int, default=777)
     p_ood.add_argument("--families", default=None,
                        help="comma list: clean,rotation,noise,morph,tails,scale")
@@ -425,12 +429,21 @@ def main(argv=None) -> None:
                           "margin_jitter": index.get("margin_jitter")}))
         return
     if args.cmd == "eval-ood":
-        from featurenet_tpu.ood import evaluate_ood
+        from featurenet_tpu.ood import evaluate_ood, evaluate_ood_seg
+        from featurenet_tpu.train.checkpoint import load_run_config
 
-        rows = evaluate_ood(
-            args.checkpoint_dir, per_class=args.per_class, seed=args.seed,
-            families=args.families.split(",") if args.families else None,
-        )
+        saved = load_run_config(args.checkpoint_dir)
+        if saved is not None and saved.task == "segment":
+            rows = evaluate_ood_seg(
+                args.checkpoint_dir, parts=args.seg_parts, seed=args.seed,
+                families=args.families.split(",") if args.families else None,
+            )
+        else:
+            rows = evaluate_ood(
+                args.checkpoint_dir, per_class=args.per_class,
+                seed=args.seed,
+                families=args.families.split(",") if args.families else None,
+            )
         for r in rows:
             print(json.dumps(r))
         if args.out:

@@ -239,6 +239,11 @@ class Config:
                     "augment=True would otherwise be silently ignored — "
                     "pass augment=False to train unaugmented"
                 )
+        # A list-valued scale range (hand-built Config; config_from_dict
+        # already tuple-izes) must compare equal to the tuple default.
+        scale_range = tuple(self.augment_scale_range)
+        if scale_range != self.augment_scale_range:
+            object.__setattr__(self, "augment_scale_range", scale_range)
         if not self.augment_affine:
             # Knobs of a disabled mechanism must not parse-and-ignore (the
             # same refusal convention as the hbm/augment guards below).

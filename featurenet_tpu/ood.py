@@ -129,6 +129,29 @@ def remesh(grid: np.ndarray, margin: float = 0.05) -> np.ndarray:
                     margin=margin)
 
 
+def _family_rng(seed: int, family: str, level) -> np.random.Generator:
+    """Per-(family, level) stream keyed via stable CRC digests —
+    reproducible across processes (Python's hash() is salted) and
+    independent of which other rows a report includes. Shared by the
+    classify and seg harnesses so their seeding conventions cannot
+    diverge."""
+    import zlib
+
+    return np.random.default_rng(np.random.SeedSequence([
+        seed,
+        zlib.crc32(family.encode()),
+        zlib.crc32(repr(level).encode()),
+    ]))
+
+
+def _annotate_delta(rows: list[dict], key: str) -> list[dict]:
+    """Delta of ``key`` vs the report's own clean control row."""
+    clean = next(r[key] for r in rows if r["family"] == "clean")
+    for r in rows:
+        r["delta_vs_clean"] = round(r[key] - clean, 4)
+    return rows
+
+
 def _perturb(family: str, level, grid: np.ndarray, rng) -> np.ndarray:
     g = grid.astype(bool)
     if family in ("clean", "tails"):
@@ -182,24 +205,13 @@ def evaluate_ood(
     if ("clean", None) not in levels:
         levels.insert(0, ("clean", None))
 
-    import zlib
-
     rows = []
     for family, level in levels:
-        # Per-level stream keyed off (seed, family, level) via stable CRC
-        # digests — reproducible across processes (Python's hash() is
-        # salted) and independent of which other rows the report includes.
         # Independent of every training seed; the clean row and a perturbed
-        # row therefore see different draws of the same distribution
-        # (fresh-draw variance, a few tenths of a point at per_class=50,
-        # is part of the quoted delta).
-        rng = np.random.default_rng(
-            np.random.SeedSequence([
-                seed,
-                zlib.crc32(family.encode()),
-                zlib.crc32(repr(level).encode()),
-            ])
-        )
+        # row see different draws of the same distribution (fresh-draw
+        # variance, a few tenths of a point at per_class=50, is part of
+        # the quoted delta).
+        rng = _family_rng(seed, family, level)
         confusion = np.zeros((NUM_CLASSES, NUM_CLASSES), np.int64)
         for c in range(NUM_CLASSES):
             grids = np.empty((per_class, R, R, R), np.float32)
@@ -226,12 +238,196 @@ def evaluate_ood(
             "min_class_accuracy": round(float(per_cls[worst]), 4),
             "worst_class": CLASS_NAMES[worst],
         })
-    clean_acc = next(
-        r["accuracy"] for r in rows if r["family"] == "clean"
+    return _annotate_delta(rows, "accuracy")
+
+
+# --- segmentation robustness -------------------------------------------------
+# The seg modality is aligned-unit-cube (labels live in the part's own grid
+# frame — data/offline.build_seg_cache), so fresh generator draws ARE the
+# clean control; no margin re-normalization is involved. Geometry families
+# therefore warp in GRID space (the same space the training augmentation
+# uses), with trilinear+threshold resampling for voxels and nearest for
+# labels so input and ground truth move together. Rotation rows compose a
+# fixed 0.7 pre-scale so rotated stock stays in-grid (the classify
+# harness's mesh pipeline shrinks rotated parts the same way, up to 1/√3);
+# the scale-0.7 row is the matching control, so rotation deltas read
+# against it, not against clean.
+
+SEG_DEFAULT_LEVELS: tuple = (
+    ("clean", None),
+    ("rotation", 5.0),
+    ("rotation", 15.0),
+    ("rotation", 45.0),
+    ("rotation", "so3"),
+    ("scale", 0.7),
+    ("scale", 0.9),
+    ("scale", 1.1),
+    ("noise", 0.005),
+    ("noise", 0.01),
+    ("morph", "dilate"),
+    ("morph", "erode"),
+    ("tails", None),
+)
+
+ROTATION_PRESCALE = 0.7
+
+
+def _trilinear(vol: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Sample float ``vol`` [R,R,R] at ``src`` [3, N] (zero outside)."""
+    R = vol.shape[0]
+    f = np.floor(src).astype(np.int64)
+    t = src - f
+    out = np.zeros(src.shape[1], np.float32)
+    for dz in (0, 1):
+        for dy in (0, 1):
+            for dx in (0, 1):
+                idx = f + np.array([[dz], [dy], [dx]])
+                w = (
+                    (t[0] if dz else 1 - t[0])
+                    * (t[1] if dy else 1 - t[1])
+                    * (t[2] if dx else 1 - t[2])
+                )
+                valid = ((idx >= 0) & (idx < R)).all(axis=0)
+                ic = np.clip(idx, 0, R - 1)
+                out += w * np.where(
+                    valid, vol[ic[0], ic[1], ic[2]], 0.0
+                )
+    return out
+
+
+def affine_resample_pair(
+    vox: np.ndarray,
+    seg: np.ndarray | None,
+    rot: np.ndarray | None = None,
+    scale: float = 1.0,
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Grid-space affine about the center: voxels trilinear + 0.5
+    threshold (≈ re-rasterization of the implicit surface), labels
+    nearest — the numpy eval-side mirror of
+    ``ops.augment.random_affine_batch_paired``'s per-sample warp."""
+    R = vox.shape[0]
+    c = (R - 1) / 2.0
+    grid = np.stack(np.meshgrid(
+        np.arange(R, dtype=np.float64),
+        np.arange(R, dtype=np.float64),
+        np.arange(R, dtype=np.float64),
+        indexing="ij",
+    )).reshape(3, -1)
+    src = (grid - c) / scale
+    if rot is not None:
+        src = rot.T @ src
+    src = src + c
+    out_v = (_trilinear(vox.astype(np.float32), src) > 0.5).reshape(
+        (R, R, R)
     )
-    for r in rows:
-        r["delta_vs_clean"] = round(r["accuracy"] - clean_acc, 4)
-    return rows
+    out_s = None
+    if seg is not None:
+        n = np.rint(src).astype(np.int64)
+        valid = ((n >= 0) & (n < R)).all(axis=0)
+        nc = np.clip(n, 0, R - 1)
+        out_s = np.where(
+            valid, seg[nc[0], nc[1], nc[2]], 0
+        ).reshape((R, R, R)).astype(seg.dtype)
+    return out_v, out_s
+
+
+def _perturb_seg(family, level, vox, seg, rng):
+    """(input voxels, ground truth) for one seg-OOD row. Geometry families
+    warp both; corruption families (noise/morph) perturb the input only —
+    the model should recover the underlying part's segmentation."""
+    if family in ("clean", "tails"):
+        return vox, seg
+    if family == "rotation":
+        rot = random_rotation_matrix(
+            rng, None if level == "so3" else float(level)
+        )
+        return affine_resample_pair(vox, seg, rot, ROTATION_PRESCALE)
+    if family == "scale":
+        return affine_resample_pair(vox, seg, None, float(level))
+    if family == "noise":
+        return vox ^ (rng.random(vox.shape) < float(level)), seg
+    if family == "morph":
+        g = dilate(vox) if level == "dilate" else erode(vox)
+        return g, seg
+    raise ValueError(f"unknown seg OOD family {family!r}")
+
+
+def evaluate_ood_seg(
+    checkpoint_dir: str,
+    parts: int = 60,
+    seed: int = 777,
+    levels=None,
+    families=None,
+    batch: int = 16,
+    progress=None,
+) -> list[dict]:
+    """Robustness report for a segmentation checkpoint: one row per
+    (family, level) with exact summed per-class IoU over ``parts`` fresh
+    generator draws (never a cache split; the canonical-label seg
+    generator, ambient ``param_range`` for the tails row)."""
+    from featurenet_tpu.data.offline import _generate_seg_sample
+    from featurenet_tpu.data.synthetic import param_range
+    from featurenet_tpu.infer import Predictor
+
+    p = Predictor.from_checkpoint(checkpoint_dir, batch=batch)
+    if p.cfg.task != "segment":
+        raise ValueError("evaluate_ood_seg runs on segment checkpoints")
+    R = p.cfg.resolution
+    nf = p.cfg.num_features
+    n_cls = NUM_CLASSES + 1
+
+    known = {lv[0] for lv in SEG_DEFAULT_LEVELS}
+    if families:
+        bad = sorted(set(families) - known)
+        if bad:
+            raise ValueError(
+                f"unknown seg OOD families {bad}; known: {sorted(known)}"
+            )
+    levels = list(levels if levels is not None else SEG_DEFAULT_LEVELS)
+    if families:
+        levels = [lv for lv in levels if lv[0] in families]
+    if ("clean", None) not in levels:
+        levels.insert(0, ("clean", None))
+
+    rows = []
+    for family, level in levels:
+        rng = _family_rng(seed, family, level)
+        inter = np.zeros(n_cls, np.float64)
+        union = np.zeros(n_cls, np.float64)
+        correct = total = 0
+        for start in range(0, parts, batch):
+            n = min(batch, parts - start)
+            vox = np.empty((n, R, R, R), np.float32)
+            gt = np.empty((n, R, R, R), np.int32)
+            for i in range(n):
+                with param_range("tails" if family == "tails" else None):
+                    part, s = _generate_seg_sample(
+                        rng, R, nf, "canonical"
+                    )
+                v, s2 = _perturb_seg(
+                    family, level, part.astype(bool), s, rng
+                )
+                vox[i] = v.astype(np.float32)
+                gt[i] = s2
+            pred = p.predict_voxels_seg(vox).astype(np.int32)
+            for c in range(n_cls):
+                pc, tc = pred == c, gt == c
+                inter[c] += (pc & tc).sum()
+                union[c] += (pc | tc).sum()
+            correct += (pred == gt).sum()
+            total += pred.size
+            if progress:
+                progress(family, level, start + n)
+        present = union > 0
+        iou = np.where(present, inter / np.maximum(union, 1), 0.0)
+        rows.append({
+            "family": family,
+            "level": level,
+            "n": parts,
+            "mean_iou": round(float(iou.sum() / max(present.sum(), 1)), 4),
+            "voxel_accuracy": round(float(correct / total), 4),
+        })
+    return _annotate_delta(rows, "mean_iou")
 
 
 def main(argv=None) -> None:

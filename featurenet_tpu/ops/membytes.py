@@ -83,13 +83,12 @@ def classifier_act_bytes_per_sample(arch, resolution: int) -> int:
     conv stack + fp32 input/loss edges), the same walk as
     ``flops.classifier_forward_flops``."""
     total = 4 * resolution**3  # unpacked fp32 input
-    d, c_in = resolution, 1
+    d = resolution
     for f, s, p in zip(arch.features, arch.strides, arch.pool_after):
         d = math.ceil(d / s)
         total += int(CONV_BLOCK_TENSORS * 2 * f * d**3)
         if p:
             d //= 2
-        c_in = f
     flat = arch.features[-1] if arch.head_gap else arch.features[-1] * d**3
     # Dense-land: flatten/GAP out, hidden (+ dropout mask), logits + softmax.
     total += 4 * flat + 3 * 4 * arch.hidden + 3 * 4 * arch.num_classes
