@@ -276,6 +276,21 @@ def main(argv=None) -> None:
                        help="comma list: clean,rotation,noise,morph,tails,scale")
     p_ood.add_argument("--out", default=None,
                        help="also write the report rows as a JSON file")
+    p_rec = sub.add_parser("recalibrate", allow_abbrev=False,
+                           help="re-estimate a checkpoint's BatchNorm "
+                                "running statistics over clean training "
+                                "batches and save the result as a new "
+                                "checkpoint (recovers the clean-modality "
+                                "eval tax of mixed-distribution training)")
+    p_rec.add_argument("--checkpoint-dir", required=True)
+    p_rec.add_argument("--out-dir", required=True,
+                       help="directory for the recalibrated checkpoint "
+                            "(the source checkpoint is never modified)")
+    p_rec.add_argument("--batches", type=int, default=64,
+                       help="clean train batches to stream through "
+                            "(momentum-0.9 stats converge in ~30)")
+    p_rec.add_argument("--data-cache", dest="rec_data_cache", default=None,
+                       help="override the persisted data_cache path")
     p_seg = sub.add_parser("export-seg-data",
                            help="materialize multi-feature parts with "
                                 "per-voxel ground truth as a seg cache")
@@ -449,6 +464,78 @@ def main(argv=None) -> None:
         if args.out:
             with open(args.out, "w") as fh:
                 json.dump(rows, fh, indent=1)
+        return
+    if args.cmd == "recalibrate":
+        import dataclasses as _dc
+
+        from featurenet_tpu.train.checkpoint import (
+            CheckpointManager,
+            load_run_config,
+        )
+        from featurenet_tpu.train.loop import Trainer
+
+        import os as _os
+
+        if args.batches < 1:
+            raise SystemExit(
+                "recalibrate: --batches must be >= 1 (a 0-batch run would "
+                "save an unchanged copy labeled as recalibrated)"
+            )
+        if (_os.path.realpath(args.out_dir)
+                == _os.path.realpath(args.checkpoint_dir)):
+            raise SystemExit(
+                "recalibrate: --out-dir must differ from --checkpoint-dir "
+                "(the source checkpoint is never modified)"
+            )
+        saved = load_run_config(args.checkpoint_dir)
+        if saved is None:
+            raise SystemExit(
+                "recalibrate: no persisted config next to this checkpoint"
+            )
+        # Host-stream-only build: recalibration never runs a train step,
+        # so skip the resident-split upload and fused-dispatch compiles.
+        # augment=False: the stats must come from the CLEAN stream — for
+        # streamed segment (and host-augmented classify) the dataset would
+        # otherwise rotate every sample in the workers.
+        cfg = _dc.replace(
+            saved,
+            checkpoint_dir=args.checkpoint_dir,
+            hbm_cache=False,
+            steps_per_dispatch=1,
+            heartbeat_file=None,
+            restart_every_steps=None,
+            data_cache=args.rec_data_cache or saved.data_cache,
+            augment=False,
+            # A mixed-training run's affine config is irrelevant here (no
+            # train step runs) but must not trip the validate-time guards
+            # when augment_affine relied on hbm_cache for device_augment.
+            augment_affine=False,
+            augment_affine_prob=1.0,
+            augment_ramp_steps=0,
+            augment_affine_rotate=True,
+            augment_scale_range=(0.7, 1.05),
+            augment_translate_vox=0.0,
+        ).validate()
+        trainer = Trainer(cfg)
+        at = trainer.resume_if_available()
+        if not at:
+            raise SystemExit("recalibrate: no checkpoint to restore")
+        trainer.recalibrate_bn(args.batches)
+        # Persist the ORIGINAL run config (not the host-stream eval build):
+        # a later resume/fine-tune from out-dir must reconstruct the same
+        # experiment (hbm/affine/dispatch settings), only with fresh stats.
+        out = CheckpointManager(
+            args.out_dir,
+            config=_dc.replace(saved, checkpoint_dir=args.out_dir),
+        )
+        out.save(trainer.state)
+        out.wait()
+        out.close()
+        print(json.dumps({
+            "recalibrated": args.out_dir,
+            "from_step": at,
+            "batches": args.batches,
+        }))
         return
     if args.cmd == "export-seg-data":
         from featurenet_tpu.data.offline import export_seg_cache

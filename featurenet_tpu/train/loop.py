@@ -436,6 +436,56 @@ class Trainer:
             )
         return metrics
 
+    def recalibrate_bn(self, batches: int = 64) -> None:
+        """Re-estimate BatchNorm running statistics over CLEAN training
+        batches (train-mode forwards, no optimizer — only batch_stats
+        move).
+
+        Mixed-distribution training (clean/affine batch mixing,
+        ``augment_affine_prob < 1``) leaves the BN running stats blended
+        over the mix; eval/serving on the clean modality then pays an
+        eval-only accuracy tax — the same mechanism the round-4 recipe
+        study identified during high-lr phases (BASELINE.md). The host
+        stream used here is the UN-augmented cache/synthetic feed (device
+        augmentation lives inside the train step, which this never calls).
+        """
+        from featurenet_tpu.parallel.mesh import replicated as _rep
+        from featurenet_tpu.train.steps import _batch_voxels
+
+        def fwd(params, stats, batch, rng):
+            _, mutated = self.model.apply(
+                {"params": params, "batch_stats": stats},
+                _batch_voxels(batch, True),
+                train=True,
+                rngs={"dropout": rng},
+                mutable=["batch_stats"],
+            )
+            return mutated["batch_stats"]
+
+        jfwd = jax.jit(
+            fwd,
+            in_shardings=(
+                self.state_sh.params, self.state_sh.batch_stats,
+                self.batch_sh, _rep(self.mesh),
+            ),
+            out_shardings=self.state_sh.batch_stats,
+        )
+        # Fresh dropout mask per batch (mirroring the train step's per-step
+        # fold): stats must average over the dropout marginal, not condition
+        # on one fixed realization. Jitted like _step_rng itself — eager key
+        # ops on a replicated multi-process array would fail.
+        fold = jax.jit(jax.random.fold_in)
+        it = self.train_data.worker_iter(0, 1)
+        stats = self.state.batch_stats
+        for i in range(batches):
+            batch = put_batch(next(it), self.batch_sh)
+            stats = jfwd(
+                self.state.params, stats, batch, fold(self._step_rng, i)
+            )
+        self.state = self.state.replace(
+            batch_stats=jax.block_until_ready(stats)
+        )
+
     def resume_if_available(self) -> int:
         if self.ckpt and self.ckpt.latest_step() is not None:
             self.state = self.ckpt.restore(self.state)

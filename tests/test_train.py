@@ -235,6 +235,40 @@ def test_trainer_clamps_dispatch_k(monkeypatch, capsys):
     assert "dispatch_warning" in capsys.readouterr().err
 
 
+def test_recalibrate_bn(tmp_path):
+    """BN recalibration: clean-stream forwards move only batch_stats;
+    the CLI writes a restorable new checkpoint at the same step."""
+    from featurenet_tpu.cli import main as cli_main
+
+    src = str(tmp_path / "src")
+    cfg = get_config(
+        "smoke16", total_steps=2, eval_every=10**9, checkpoint_every=2,
+        log_every=1, data_workers=1, eval_batches=1, checkpoint_dir=src,
+    )
+    t = Trainer(cfg)
+    t.run()
+    params_before = [np.asarray(x) for x in
+                     jax.tree_util.tree_leaves(t.state.params)]
+    stats_before = [np.asarray(x) for x in
+                    jax.tree_util.tree_leaves(t.state.batch_stats)]
+    t.recalibrate_bn(batches=3)
+    for a, b in zip(params_before,
+                    jax.tree_util.tree_leaves(t.state.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    assert any(
+        not np.array_equal(a, np.asarray(b))
+        for a, b in zip(stats_before,
+                        jax.tree_util.tree_leaves(t.state.batch_stats))
+    )
+    out = str(tmp_path / "recal")
+    cli_main(["recalibrate", "--checkpoint-dir", src, "--out-dir", out,
+              "--batches", "2"])
+    restored = Trainer(get_config(
+        "smoke16", data_workers=1, eval_batches=1, checkpoint_dir=out,
+    ))
+    assert restored.resume_if_available() == 2
+
+
 def test_measure_e2e_smoke():
     """The e2e wall-clock benchmark runs the Trainer's own dispatch path
     and returns a positive rate with in-artifact spread (CPU, tiny)."""
