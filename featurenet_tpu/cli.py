@@ -276,6 +276,15 @@ def main(argv=None) -> None:
                        help="comma list: clean,rotation,noise,morph,tails,scale")
     p_ood.add_argument("--out", default=None,
                        help="also write the report rows as a JSON file")
+    p_ood.add_argument("--canonicalize", action="store_true",
+                       help="classify checkpoints: undo arbitrary pose by "
+                            "min-AABB canonicalization before predicting "
+                            "(robust-serving mode; implies --tta, which "
+                            "resolves the residual 24-pose ambiguity)")
+    p_ood.add_argument("--tta", action="store_true", dest="tta_rotations",
+                       help="classify checkpoints: average probabilities "
+                            "over the 24 cube-group orientations (resolves "
+                            "canonicalization ambiguity; 24x device work)")
     p_rec = sub.add_parser("recalibrate", allow_abbrev=False,
                            help="re-estimate a checkpoint's BatchNorm "
                                 "running statistics over clean training "
@@ -449,6 +458,11 @@ def main(argv=None) -> None:
 
         saved = load_run_config(args.checkpoint_dir)
         if saved is not None and saved.task == "segment":
+            if args.canonicalize or args.tta_rotations:
+                raise SystemExit(
+                    "eval-ood: --canonicalize/--tta are classify-only "
+                    "(per-voxel labels would need the inverse warp)"
+                )
             rows = evaluate_ood_seg(
                 args.checkpoint_dir, parts=args.seg_parts, seed=args.seed,
                 families=args.families.split(",") if args.families else None,
@@ -458,6 +472,8 @@ def main(argv=None) -> None:
                 args.checkpoint_dir, per_class=args.per_class,
                 seed=args.seed,
                 families=args.families.split(",") if args.families else None,
+                canonicalize=args.canonicalize,
+                tta_rotations=args.tta_rotations,
             )
         for r in rows:
             print(json.dumps(r))

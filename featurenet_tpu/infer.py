@@ -137,12 +137,30 @@ class Predictor:
 
     # -- prediction ---------------------------------------------------------
     def predict_voxels(
-        self, grids: np.ndarray
+        self,
+        grids: np.ndarray,
+        canonicalize: bool = False,
+        tta_rotations: bool = False,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Classify ``[N, R, R, R]`` (or ``[N,R,R,R,1]``) occupancy grids.
 
         Returns ``(labels int32 [N], probs float32 [N, num_classes])``.
         Inputs are chunked/padded to the static compile batch.
+
+        Robust-serving modes (round 5 — BASELINE.md "pose canonicalization"):
+
+        - ``canonicalize=True``: undo arbitrary SO(3) pose per part by
+          min-AABB search + re-voxelization through the benchmark mesh
+          pipeline (``data/canonicalize.py``) — the part re-enters the
+          training distribution (pose AND scale normalized), up to
+          cube-group ambiguity. Host-side, ~0.5 s/part at 64³.
+          IMPLIES ``tta_rotations``: the min-AABB result lands on an
+          arbitrary one of the 24 cube orientations, so the vote is what
+          makes the canonicalized answer well-defined.
+        - ``tta_rotations=True``: classify all 24 cube-group orientations
+          and average probabilities — resolves the canonicalization
+          ambiguity (and is a cheap invariance lift on its own: rotations
+          are pure layout ops). 24× the device work per part.
         """
         if self.cfg.task == "segment":
             raise ValueError(
@@ -156,7 +174,37 @@ class Predictor:
                 np.zeros((0,), np.int32),
                 np.zeros((0, len(CLASS_NAMES)), np.float32),
             )
-        probs = self._batched_forward(g)
+        if canonicalize:
+            from featurenet_tpu.data.canonicalize import (
+                canonicalize as _canon,
+            )
+
+            g = np.stack([
+                _canon(g[i, ..., 0] > 0.5).astype(np.float32)
+                for i in range(n)
+            ])[..., None]
+            tta_rotations = True  # the vote resolves the 24-fold ambiguity
+        if tta_rotations:
+            from featurenet_tpu.ops.augment import CUBE_GROUP
+
+            # Mean probability over the 24 axis-aligned orientations. The
+            # rotations are numpy transposes/flips on the host (batch dim 0
+            # untouched), stacked into ONE forward stream so the static-
+            # batch padding is paid once per chunk, not 24 times.
+            rots = []
+            for perm, flips in CUBE_GROUP:
+                rot = np.transpose(
+                    g, (0,) + tuple(1 + p for p in perm) + (4,)
+                )
+                ax = [1 + i for i, f in enumerate(flips) if f]
+                if ax:
+                    rot = np.flip(rot, ax)
+                rots.append(rot)
+            stacked = np.ascontiguousarray(np.concatenate(rots, axis=0))
+            p = self._batched_forward(stacked)
+            probs = p.reshape(len(CUBE_GROUP), n, -1).mean(axis=0)
+        else:
+            probs = self._batched_forward(g)
         return probs.argmax(axis=-1).astype(np.int32), probs
 
     def _batched_forward(self, g: np.ndarray) -> np.ndarray:

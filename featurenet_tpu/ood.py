@@ -177,6 +177,8 @@ def evaluate_ood(
     families=None,
     batch: int = 64,
     progress=None,
+    canonicalize: bool = False,
+    tta_rotations: bool = False,
 ) -> list[dict]:
     """Run the robustness report on a classification checkpoint.
 
@@ -221,7 +223,10 @@ def evaluate_ood(
                     param_range="tails" if family == "tails" else None,
                 )
                 grids[i] = _perturb(family, level, part, rng)
-            pred, _ = p.predict_voxels(grids)
+            pred, _ = p.predict_voxels(
+                grids, canonicalize=canonicalize,
+                tta_rotations=tta_rotations,
+            )
             for q in pred:
                 confusion[c, int(q)] += 1
             if progress:

@@ -154,6 +154,70 @@ def test_warm_start_init_from(tmp_path):
         ))
 
 
+def test_canonicalize_recovers_pose():
+    """Min-AABB canonicalization undoes an arbitrary rotation up to the
+    cube group: the canonicalized rotated part must overlap some cube-group
+    orientation of the (remeshed) original at near the double-rasterization
+    ceiling, and far better than the rotated input does."""
+    from featurenet_tpu.data.canonicalize import canonicalize
+    from featurenet_tpu.ood import remesh, rotate_part
+
+    from featurenet_tpu.ops.augment import CUBE_GROUP
+
+    def best_cube_iou(a, b):
+        # Proper rotations only (the real CUBE_GROUP): a reflected result
+        # must NOT pass — TTA never presents mirror images to the model.
+        best = 0.0
+        for perm, flips in CUBE_GROUP:
+            x = np.transpose(a, perm)
+            ax = [i for i, f in enumerate(flips) if f]
+            if ax:
+                x = np.flip(x, ax)
+            best = max(
+                best,
+                float((x & b).sum()) / max(float((x | b).sum()), 1),
+            )
+        return best
+
+    rng = np.random.default_rng(3)
+    part, _, _ = syn.generate_sample(rng, 32, label=7)
+    ref = remesh(part.astype(bool))
+    rot = rotate_part(part.astype(bool), rng, None)
+    can = canonicalize(rot)
+    assert best_cube_iou(can, ref) > 0.6
+    assert best_cube_iou(can, ref) > best_cube_iou(rot, ref) + 0.15
+
+
+def test_predictor_tta_and_canonicalize_smoke(tmp_path):
+    """predict_voxels robust modes: TTA probabilities are a valid
+    distribution and cube-rotation-invariant by construction; the
+    canonicalize path runs end to end."""
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.train import Trainer
+
+    cfg = get_config(
+        "smoke16", total_steps=2, eval_every=10**9, checkpoint_every=2,
+        log_every=1, data_workers=1, eval_batches=1,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    Trainer(cfg).run()
+    p = Predictor.from_checkpoint(str(tmp_path / "ck"), batch=8)
+    g = np.zeros((2, 16, 16, 16), np.float32)
+    g[:, 4:12, 4:12, 4:12] = 1.0
+    g[0, 6:10, 6:10, 4:8] = 0.0  # a carve so rotations differ
+    _, probs = p.predict_voxels(g, tta_rotations=True)
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-4)
+    # TTA output is invariant to a cube-group rotation of the input.
+    rot = np.flip(np.transpose(g, (0, 2, 1, 3)), 1)
+    _, probs_rot = p.predict_voxels(
+        np.ascontiguousarray(rot), tta_rotations=True
+    )
+    np.testing.assert_allclose(probs, probs_rot, atol=1e-5)
+    labels, _ = p.predict_voxels(g, canonicalize=True)
+    assert labels.shape == (2,)
+
+
 def test_dilate_erode():
     g = np.zeros((12, 12, 12), bool)
     g[4:8, 4:8, 4:8] = True
