@@ -412,6 +412,50 @@ def test_trainer_planned_restart_segments(tmp_path):
     assert "loss" in last
 
 
+def test_converged_slope_protocol():
+    """The shared slope protocol: window floors at ~min_window_sec of
+    device work, contaminated (non-positive) draws are dropped, the
+    headline is the MEAN of the two agreeing best draws (not the min),
+    and both spread views are reported."""
+    from featurenet_tpu.benchmark import _converged_slope
+
+    # Fake device: 10 ms/call, with one stalled short-probe draw (walled(1)
+    # slower than walled(N+1) -> negative slope) injected first.
+    calls = {"n": 0}
+
+    def walled(k):
+        calls["n"] += 1
+        if calls["n"] == 3:  # first measurement draw's short probe stalls
+            return 10.0
+        return 0.010 * k
+
+    out = _converged_slope(walled, measure=20, repeats=2,
+                           min_window_sec=1.0)
+    # Window grew to ~1 s of 10 ms calls.
+    assert out["window_calls"] >= 100
+    assert abs(out["per_call"] - 0.010) / 0.010 < 0.01
+    assert out["spread_pct"] <= 3.0
+    assert out["spread_minmax_pct"] >= out["spread_pct"]
+
+    # Two clean draws with slightly different rates: headline is their
+    # mean, not the min.
+    rates = iter([0.010, 0.010, 0.010, 0.0102] * 50)
+
+    def walled2(k):
+        return next(rates) * k
+
+    out2 = _converged_slope(walled2, measure=10, repeats=2,
+                            min_window_sec=0.0)
+    assert out2["per_call"] > 0.010  # min would be exactly 0.010
+
+    def always_stalled(k):
+        return 1.0 if k == 1 else 0.5
+
+    with pytest.raises(RuntimeError, match="contaminated"):
+        _converged_slope(always_stalled, measure=5, repeats=2,
+                         min_window_sec=0.0)
+
+
 def test_measure_train_step_rejects_segment_config():
     """benchmark.measure_train_step builds a classifier on the classify wire
     format unconditionally — a segment config must be refused, not silently
