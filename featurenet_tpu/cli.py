@@ -41,6 +41,11 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile-dir", help="capture an XProf trace here")
     p.add_argument("--tb-dir", help="mirror scalar metrics to TensorBoard "
                                     "event files here")
+    p.add_argument("--run-dir", dest="run_dir",
+                   help="run-scoped observability directory: writes "
+                        "run.json + events.jsonl (spans, gauges, metrics, "
+                        "warnings, heartbeats, supervisor restarts); "
+                        "analyze with `cli report <run_dir>`")
     p.add_argument("--no-augment", action="store_true",
                    help="disable train-time pose augmentation (cache-backed)")
     p.add_argument("--augment-affine", action="store_true",
@@ -139,7 +144,7 @@ def _overrides(args) -> dict:
     keys = [
         "resolution", "global_batch", "peak_lr", "total_steps", "seed",
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
-        "profile_dir", "tb_dir", "heartbeat_file", "seg_loss",
+        "profile_dir", "tb_dir", "run_dir", "heartbeat_file", "seg_loss",
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
         "augment_translate_vox", "init_from",
@@ -150,6 +155,11 @@ def _overrides(args) -> dict:
         for k in keys
         if getattr(args, k, None) is not None
     }
+    if getattr(args, "steps_per_dispatch", None) is not None:
+        # An explicitly requested k is honored as-is: the operator opted
+        # out of the first-order membytes clamp (the Trainer still warns
+        # when the request exceeds the model — advisor r5).
+        out["clamp_dispatch_k"] = False
     if getattr(args, "augment_scale_range", None) is not None:
         out["augment_scale_range"] = tuple(args.augment_scale_range)
     if getattr(args, "no_augment_affine_rotate", False):
@@ -217,7 +227,7 @@ def _cfg_from_checkpoint(saved, args):
     # supervisor's child argv re-passes --restart-every every spawn); an
     # unsupervised resume inheriting it from the sidecar would die with
     # exit 75 mid-run and nothing would respawn it.
-    for k in ("heartbeat_file", "profile_dir", "tb_dir",
+    for k in ("heartbeat_file", "profile_dir", "tb_dir", "run_dir",
               "restart_every_steps"):
         over.setdefault(k, None)
     # Arch flags must reach the returned config too — check_identity above
@@ -345,6 +355,19 @@ def main(argv=None) -> None:
     p_bld.add_argument("--workers", type=int, default=None,
                        help="process-pool width for per-file voxelization "
                             "(default: cpu count; 1 = serial)")
+    p_rep = sub.add_parser("report", allow_abbrev=False,
+                           help="analyze a run directory's observability "
+                                "log (featurenet_tpu.obs): step-time "
+                                "breakdown, input-pipeline health, "
+                                "restart/stall timeline, serving latency")
+    p_rep.add_argument("run_dir", help="directory a run wrote via --run-dir")
+    p_rep.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the raw report dict as JSON instead of "
+                            "the human-readable rendering")
+    p_rep.add_argument("--trace", default=None,
+                       help="also export the timing spans as a Chrome "
+                            "trace.json to this path (chrome://tracing, "
+                            "ui.perfetto.dev)")
     p_inf = sub.add_parser("infer", allow_abbrev=False,
                            help="classify or segment STL files with a "
                                 "trained checkpoint")
@@ -367,7 +390,43 @@ def main(argv=None) -> None:
                        help="segment checkpoints: also write each part's "
                             "per-voxel label grid to this directory as "
                             "<stem>_seg.npz")
+    p_inf.add_argument("--run-dir", dest="run_dir",
+                       help="observability directory: record per-batch "
+                            "serving latency spans (see `cli report`)")
     args = parser.parse_args(argv)
+
+    if args.cmd == "report":
+        # Post-hoc analysis of a finished (or crashed) run: stdlib-only —
+        # must work where the backend that produced the run is long gone.
+        import os
+
+        from featurenet_tpu.obs.events import EVENTS_FILENAME
+        from featurenet_tpu.obs.report import (
+            build_report,
+            format_report,
+            load_events,
+            load_manifest,
+        )
+
+        if not os.path.exists(os.path.join(args.run_dir, EVENTS_FILENAME)):
+            raise SystemExit(
+                f"report: no {EVENTS_FILENAME} in {args.run_dir!r} — was "
+                "the run started with --run-dir pointing here?"
+            )
+        events, bad = load_events(args.run_dir)
+        rep = build_report(events, load_manifest(args.run_dir),
+                           bad_lines=bad)
+        if args.as_json:
+            print(json.dumps(rep, indent=1, default=str))
+        else:
+            print(format_report(rep))
+        if args.trace:
+            from featurenet_tpu.obs.spans import chrome_trace
+
+            with open(args.trace, "w") as fh:
+                json.dump(chrome_trace(events), fh)
+            print(json.dumps({"trace": args.trace}))
+        return
 
     if (
         args.cmd == "train"
@@ -414,6 +473,10 @@ def main(argv=None) -> None:
                 stall_timeout_s=args.stall_timeout,
                 max_restarts=args.max_restarts,
                 heartbeat_file=hb,
+                # The child's --run-dir flows through child_argv_from_cli;
+                # the supervisor appends its own restart/stall events to
+                # the same run log.
+                run_dir=getattr(args, "run_dir", None),
             )
         finally:
             if hb_is_temp:
@@ -519,7 +582,13 @@ def main(argv=None) -> None:
             hbm_cache=False,
             steps_per_dispatch=1,
             heartbeat_file=None,
+            run_dir=None,
             restart_every_steps=None,
+            # Recalibration restores from checkpoint_dir (resume wins over
+            # warm start) — re-running the persisted init_from would pay
+            # the warm-start restore for nothing, and crash outright when
+            # that source dir has since moved (advisor r5).
+            init_from=None,
             data_cache=args.rec_data_cache or saved.data_cache,
             augment=False,
             # A mixed-training run's affine config is irrelevant here (no
@@ -647,6 +716,11 @@ def main(argv=None) -> None:
                 f"(config {cfg.name!r} has task={cfg.task!r}); it would "
                 "silently produce no label grids"
             )
+        if getattr(args, "run_dir", None):
+            from featurenet_tpu import obs
+            from featurenet_tpu.config import config_to_dict
+
+            obs.init_run(args.run_dir, config=config_to_dict(cfg))
         # Compile batch sized to the request: padding 1 STL to the default
         # 32 would run 32x the needed FLOPs (felt hardest by the
         # full-resolution segmentation decoder).

@@ -138,6 +138,13 @@ class Config:
     # Logging/eval/checkpoint cadences keep their step semantics but fire
     # on dispatch boundaries.
     steps_per_dispatch: int = 1
+    # Clamp steps_per_dispatch against the analytic HBM byte model
+    # (ops/membytes.max_feasible_k) before compiling the fused executable.
+    # True (the default) protects preset-derived k values degrading onto
+    # smaller hardware; the CLI sets False when --steps-per-dispatch is
+    # passed explicitly, so an operator can opt out of the first-order
+    # model (the warning still fires — the OOM risk is theirs).
+    clamp_dispatch_k: bool = True
     # Backpressure: max train steps dispatched ahead of confirmed execution.
     # Async dispatch with no bound pins every in-flight batch in memory; on
     # backends where block_until_ready is unreliable (this environment's
@@ -154,6 +161,13 @@ class Config:
     # Logging / checkpointing. tb_dir: also mirror scalar metrics to
     # TensorBoard event files (CLU metric_writers).
     tb_dir: Optional[str] = None
+    # Run-scoped observability (featurenet_tpu.obs): when set, the run
+    # writes a manifest (run.json) and an append-only event log
+    # (events.jsonl) into this directory — timing spans, gauges, metrics,
+    # warnings, heartbeats, supervisor restarts. Analyze post-hoc with
+    # `python -m featurenet_tpu.cli report <run_dir>`. None (default) =
+    # no obs file I/O and zero dispatch-path overhead.
+    run_dir: Optional[str] = None
     # Liveness: when set, the Trainer touches this file at every confirmed
     # point of progress (a device readback, an eval, a checkpoint). A
     # supervisor (train.supervisor / `cli train --supervise`) watches the

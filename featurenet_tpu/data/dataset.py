@@ -19,10 +19,12 @@ exhaustion propagate to the consumer instead of hanging it.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterator
 
 import numpy as np
 
+from featurenet_tpu import obs
 from featurenet_tpu.data.synthetic import generate_batch, to_wire
 
 
@@ -87,6 +89,11 @@ class SyntheticVoxelDataset:
 
 class _WorkerDone:
     pass
+
+
+# next() sentinel: the producer's timing wrapper must see exhaustion as a
+# value, not an exception, so its try block stays exception-transparent.
+_DONE = object()
 
 
 # (sharding, local_shape) -> slices; tiny, but put_batch is per-step.
@@ -232,7 +239,23 @@ def prefetch_to_device(
     def producer(w: int):
         ticket = w
         try:
-            for item in iters[w]:
+            it = iters[w]
+            while True:
+                # Per-batch generation timing (obs gauge): how long this
+                # worker spent producing, independent of backpressure
+                # waits — the report's "is generation the bottleneck"
+                # signal. Clock reads only while a run is active.
+                if obs.active():
+                    t0 = time.perf_counter()
+                    item = next(it, _DONE)
+                    if item is not _DONE:
+                        obs.gauge("producer_batch_s",
+                                  round(time.perf_counter() - t0, 6),
+                                  worker=w)
+                else:
+                    item = next(it, _DONE)
+                if item is _DONE:
+                    break
                 with cond:
                     while (
                         ticket >= nxt_box[0] + lookahead and not stop.is_set()
@@ -271,8 +294,15 @@ def prefetch_to_device(
                 while nxt not in out:
                     cond.wait(0.1)
                 item = out.pop(nxt)
+                depth = len(out)  # ready batches left AFTER taking ours
                 nxt_box[0] = nxt + 1
                 cond.notify_all()
+            # Queue depth at every consumer pop, measured after the pop so
+            # a starved pipeline (consumer waited for the very batch it
+            # took) reads 0: pinned at 0 = the device is starving; pinned
+            # at max = producers saturate the lookahead and the device is
+            # the bottleneck.
+            obs.gauge("prefetch_queue_depth", depth)
             if isinstance(item, _WorkerDone):
                 done_workers.add(nxt % W)
             elif isinstance(item, BaseException):

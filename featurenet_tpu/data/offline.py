@@ -111,20 +111,17 @@ def build_cache(
             label_ids[cls] = next_id
             next_id += 1
     if next_id > len(CLASS_NAMES):
-        import sys
+        from featurenet_tpu import obs
 
         unknown = [c for c in classes if c not in known]
-        print(
-            json.dumps({
-                "build_cache_warning":
-                    "non-canonical class dirs (typo'd benchmark name, or "
-                    "a custom class) get label ids past the canonical "
-                    f"block; training them needs num_classes >= {next_id} "
-                    "(stock presets have 24 — the Trainer refuses "
-                    "out-of-range labels)",
-                "dirs": unknown,
-            }),
-            file=sys.stderr,
+        obs.warn(
+            "build_cache_warning",
+            "non-canonical class dirs (typo'd benchmark name, or "
+            "a custom class) get label ids past the canonical "
+            f"block; training them needs num_classes >= {next_id} "
+            "(stock presets have 24 — the Trainer refuses "
+            "out-of-range labels)",
+            dirs=unknown,
         )
     index = {
         "resolution": resolution,

@@ -25,6 +25,7 @@ from typing import Sequence
 
 import numpy as np
 
+from featurenet_tpu import obs
 from featurenet_tpu.config import Config, get_config
 from featurenet_tpu.data.stl import load_stl
 from featurenet_tpu.data.synthetic import CLASS_NAMES
@@ -217,7 +218,14 @@ class Predictor:
                 chunk = np.concatenate(
                     [chunk, np.zeros((pad,) + chunk.shape[1:], np.float32)]
                 )
-            y = np.asarray(self._forward(self._params, self._stats, chunk))
+            # Serving latency span: np.asarray forces the readback, so the
+            # measured interval is true request latency (dispatch + device
+            # + transfer), feeding the report's latency histogram.
+            with obs.span("infer_batch", n=self.batch - pad,
+                          batch=self.batch):
+                y = np.asarray(
+                    self._forward(self._params, self._stats, chunk)
+                )
             out.append(y[: self.batch - pad])
         return np.concatenate(out, axis=0)
 

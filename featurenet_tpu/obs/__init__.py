@@ -1,0 +1,51 @@
+"""Run-scoped observability: one directory captures a whole run.
+
+The reference printed loss to stdout and nothing else (SURVEY.md §5); this
+rebuild's telemetry had grown piecemeal — ``MetricLogger`` JSON lines, a
+bare heartbeat mtime, ad-hoc ``*_warning`` prints — with no single artifact
+answering "where did this run's wall-clock go, did the input pipeline
+starve the device, and why did the supervisor restart it?" (BENCH_r05
+failed on a backend outage with no run-side record of the stall shape.)
+
+Setting ``Config.run_dir`` (CLI ``--run-dir``) makes every layer write into
+one run directory:
+
+- ``run.json``    — manifest: config, device topology, process index,
+                    start time (``events.init_run``).
+- ``events.jsonl``— append-only, thread-safe, process-shared event log:
+                    timing spans, gauges, metrics, warnings, heartbeats,
+                    supervisor restarts (``events.EventSink``).
+
+Post-hoc, ``python -m featurenet_tpu.cli report <run_dir>`` folds the event
+log into a step-time breakdown (data-wait vs device vs eval vs checkpoint),
+prefetch-queue-depth percentiles, heartbeat-age max, a restart/stall
+timeline, and a serving-latency histogram (``report.py``); ``--trace``
+exports the spans as a Chrome ``trace.json`` (``spans.chrome_trace``).
+
+With no run_dir configured every hook is a no-op behind a single ``None``
+check — no file I/O, no timestamps, no measurable train-step overhead.
+This package imports only the stdlib, so any layer may import it freely.
+"""
+
+from featurenet_tpu.obs.events import (
+    EventSink,
+    active,
+    close_run,
+    emit,
+    gauge,
+    init_run,
+    warn,
+)
+from featurenet_tpu.obs.spans import chrome_trace, span
+
+__all__ = [
+    "EventSink",
+    "active",
+    "chrome_trace",
+    "close_run",
+    "emit",
+    "gauge",
+    "init_run",
+    "span",
+    "warn",
+]

@@ -1,0 +1,211 @@
+"""Process-wide JSONL event sink + run manifest (the obs layer's spine).
+
+Event wire format: one JSON object per line in ``<run_dir>/events.jsonl``,
+every line carrying ``t`` (epoch seconds) and ``ev`` (the event type —
+``span`` / ``gauge`` / ``metrics`` / ``warning`` / ``heartbeat`` /
+``supervisor`` / ``loop_start`` / ``loop_end`` / ``run_start``). The field
+is ``ev``, not ``kind``, so ``MetricLogger`` records — which already carry
+a ``kind`` of their own — route through unmodified.
+
+Concurrency: one lock per sink serializes threads; the file is opened
+``O_APPEND`` and each event is a single short ``write()``, so independent
+*processes* (the supervisor and its supervised child, or a restarted child
+appending to the same run) interleave whole lines, never fragments. The
+manifest (``run.json``) is written once per run directory — a respawned
+child finds it present and only appends a ``run_start`` event, keeping the
+original start time while making every restart visible in the timeline.
+
+The module-level sink is what the instrumentation hooks (``emit`` /
+``gauge`` / ``spans.span``) consult; when none is installed every hook
+returns after one ``None`` check — the contract that keeps an
+un-instrumented run's dispatch path at zero overhead and zero file I/O.
+
+The sink is deliberately PROCESS-WIDE and sticky: once ``init_run``
+installs it, everything the process does afterwards — including later
+Trainers constructed with ``run_dir=None`` (a recalibration pass, an
+eval over the same weights, a benchmark rerun) — logs into the active
+run until ``close_run()`` or an ``init_run`` naming a different
+directory. That is the point: ambient work belongs to the run that is
+in flight. A process that interleaves genuinely unrelated runs must
+``init_run`` each one (which swaps the sink) or ``close_run()`` between
+them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+MANIFEST_FILENAME = "run.json"
+EVENTS_FILENAME = "events.jsonl"
+
+
+def _device_topology() -> dict:
+    """Best-effort JAX device/process topology for the manifest. Lazy and
+    guarded: the report CLI (and the supervisor process) must be able to
+    use this module without initializing a backend."""
+    try:
+        import jax
+
+        return {
+            "version": jax.__version__,
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "devices": [
+                {
+                    "id": d.id,
+                    "process_index": d.process_index,
+                    "platform": d.platform,
+                    "device_kind": d.device_kind,
+                }
+                for d in jax.devices()
+            ],
+        }
+    except Exception as e:  # no jax / no backend: manifest still valid
+        return {"error": str(e)}
+
+
+def run_manifest(run_dir: str, config: Optional[dict] = None,
+                 extra: Optional[dict] = None) -> dict:
+    import datetime
+    import socket
+
+    m: dict[str, Any] = {
+        "run_dir": os.path.abspath(run_dir),
+        "start_unix": time.time(),
+        "start_time": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "pid": os.getpid(),
+        "hostname": socket.gethostname(),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "config": config,
+        "jax": _device_topology(),
+    }
+    if extra:
+        m.update(extra)
+    return m
+
+
+class EventSink:
+    """Append-only JSONL writer for one run directory.
+
+    Standalone-instantiable (the supervisor opens its own sink into the
+    child's run_dir from a different process); training code normally goes
+    through the module-level singleton installed by ``init_run``.
+    """
+
+    def __init__(self, run_dir: str, filename: str = EVENTS_FILENAME):
+        self.run_dir = os.path.abspath(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.path = os.path.join(self.run_dir, filename)
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def emit(self, ev: str, **fields) -> None:
+        """Write one event line. A ``t`` in ``fields`` overrides the
+        auto-stamp (spans pass their start time so trace viewers see the
+        interval where it began, not where it ended). Every line carries
+        the emitting pid: several processes share one log (supervisor +
+        child, restarted children), and the Chrome trace export groups
+        spans by it."""
+        record = {"t": fields.pop("t", None) or time.time(), "ev": ev,
+                  "pid": self._pid}
+        record.update(fields)
+        line = json.dumps(record, default=str) + "\n"
+        with self._lock:
+            f = self._f
+            if f is None or f.closed:
+                return
+            f.write(line)  # one write per line: process-atomic under append
+            f.flush()      # a crashed run's log must be complete to the crash
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
+
+
+# --- module-level (process-wide) sink ----------------------------------------
+
+_sink: Optional[EventSink] = None
+_install_lock = threading.Lock()
+
+
+def init_run(run_dir: str, config: Optional[dict] = None,
+             extra: Optional[dict] = None) -> EventSink:
+    """Install the process-wide sink for ``run_dir`` and ensure ``run.json``.
+
+    Idempotent per directory: re-initializing the same run_dir (a second
+    Trainer in one process, a respawned supervised child) keeps appending
+    to the existing log; a different run_dir closes the old sink and opens
+    the new one. The manifest is written only if absent so restarts keep
+    the run's original start time; every call appends a ``run_start``
+    event, which is how the report reconstructs the restart timeline.
+    """
+    global _sink
+    with _install_lock:
+        target = os.path.abspath(run_dir)
+        if _sink is None or _sink.run_dir != target:
+            if _sink is not None:
+                _sink.close()
+            _sink = EventSink(target)
+        manifest_path = os.path.join(target, MANIFEST_FILENAME)
+        if not os.path.exists(manifest_path):
+            tmp = manifest_path + ".tmp"  # atomic: never half a manifest
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(run_manifest(run_dir, config, extra), fh,
+                          indent=1, default=str)
+            os.replace(tmp, manifest_path)
+        topo = _device_topology()
+        _sink.emit(
+            "run_start",
+            process_index=topo.get("process_index", 0),
+        )
+        return _sink
+
+
+def active() -> bool:
+    return _sink is not None
+
+
+def emit(ev: str, **fields) -> None:
+    """Emit to the process-wide sink; no-op (one None check) when none."""
+    s = _sink
+    if s is None:
+        return
+    s.emit(ev, **fields)
+
+
+def gauge(name: str, value, **fields) -> None:
+    """Point-in-time measurement (queue depth, batch-gen seconds, …)."""
+    s = _sink
+    if s is None:
+        return
+    s.emit("gauge", name=name, value=value, **fields)
+
+
+def warn(name: str, msg: str, **fields) -> None:
+    """One-line JSON warning to stderr (the pre-obs contract every ad-hoc
+    ``*_warning`` print site followed — kept so operators and tests that
+    grep stderr see the same shape) AND, when a run is active, a
+    ``warning`` event in the run log."""
+    print(json.dumps({name: msg, **fields}), file=sys.stderr)
+    s = _sink
+    if s is not None:
+        s.emit("warning", name=name, msg=msg, **fields)
+
+
+def close_run() -> None:
+    global _sink
+    with _install_lock:
+        if _sink is not None:
+            _sink.close()
+            _sink = None

@@ -1,0 +1,351 @@
+"""Post-hoc run analysis: fold ``events.jsonl`` into answers.
+
+``build_report`` turns a run directory's event log into the questions an
+operator actually asks after a run (or a crash):
+
+- **Where did the wall-clock go?** Step-time breakdown over the train
+  loop's window(s): data-wait vs dispatch vs readback vs eval vs
+  checkpoint seconds and fractions, with ``other`` as the explicit
+  remainder so the fractions always account for 100% of loop wall time.
+- **Did the input pipeline starve the device?** Prefetch queue-depth
+  percentiles (a queue pinned at 0 = starved consumer) and producer
+  batch-generation timing.
+- **Was the run healthy?** Heartbeat count + max inter-beat age, the
+  supervisor's restart/stall timeline, warning counts, and every
+  ``run_start`` (each process (re)spawn) in order.
+- **How fast is serving?** Per-batch ``infer_batch`` latency percentiles.
+
+Everything here is stdlib-only and never touches JAX — the report CLI
+must run on a machine (or in a moment) where the backend that produced
+the run is long gone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from featurenet_tpu.obs.events import EVENTS_FILENAME, MANIFEST_FILENAME
+
+# Loop-attributed span names, in display order. "device" time is
+# dispatch + readback: the dispatch call enqueues work, the readback is
+# where the host actually blocks on device execution.
+LOOP_CATEGORIES = ("data_wait", "dispatch", "readback", "eval", "checkpoint")
+
+
+def load_events(run_dir: str) -> tuple[list[dict], int]:
+    """All events, time-ordered, plus the count of unparseable lines (a
+    torn line from a killed process must not take the report down with
+    it — it is exactly the crashed run we are here to inspect)."""
+    path = os.path.join(run_dir, EVENTS_FILENAME)
+    events: list[dict] = []
+    bad = 0
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(e, dict) and "t" in e and "ev" in e:
+                events.append(e)
+            else:
+                bad += 1
+    events.sort(key=lambda e: e["t"])
+    return events, bad
+
+
+def load_manifest(run_dir: str) -> Optional[dict]:
+    path = os.path.join(run_dir, MANIFEST_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _pct(sorted_vals: list, q: float):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    i = int(round(q / 100.0 * (len(sorted_vals) - 1)))
+    return sorted_vals[min(max(i, 0), len(sorted_vals) - 1)]
+
+
+def _loop_windows(events: list[dict]) -> list[tuple[dict, dict]]:
+    """(start, end) event pairs. A trailing start without an end is the
+    run that was SIGKILLed mid-loop (a supervisor stall verdict skips the
+    finally block) — exactly the segment worth diagnosing — so it is
+    closed synthetically at the last event's timestamp instead of being
+    dropped; the synthetic end carries ``truncated: True`` and the
+    highest step any event in the window reported."""
+    def close(start: dict, t_end: float) -> dict:
+        last_step = max(
+            (e["step"] for e in events
+             if start["t"] <= e["t"] <= t_end
+             and isinstance(e.get("step"), (int, float))),
+            default=start.get("step", 0),
+        )
+        return {"t": t_end, "step": int(last_step),
+                "wall_s": t_end - start["t"], "truncated": True}
+
+    windows = []
+    start = None
+    for e in events:
+        if e["ev"] == "loop_start":
+            # A start while one is pending = the previous segment died
+            # without its loop_end and a respawn began; close the dead
+            # one at the respawn boundary so its spans stay attributed.
+            if start is not None and e["t"] > start["t"]:
+                windows.append((start, close(start, e["t"])))
+            start = e
+        elif e["ev"] == "loop_end" and start is not None:
+            windows.append((start, e))
+            start = None
+    if start is not None and events and events[-1]["t"] > start["t"]:
+        windows.append((start, close(start, events[-1]["t"])))
+    return windows
+
+
+def build_report(events: list[dict], manifest: Optional[dict] = None,
+                 bad_lines: int = 0) -> dict:
+    rep: dict = {"n_events": len(events), "bad_lines": bad_lines}
+    if manifest:
+        cfg = manifest.get("config") or {}
+        rep["run"] = {
+            "run_dir": manifest.get("run_dir"),
+            "start_time": manifest.get("start_time"),
+            "config_name": cfg.get("name"),
+            "task": cfg.get("task"),
+            "process_index": (manifest.get("jax") or {}).get("process_index"),
+            "device_count": (manifest.get("jax") or {}).get("device_count"),
+        }
+    rep["process_starts"] = sum(1 for e in events if e["ev"] == "run_start")
+
+    # --- step-time breakdown over the loop window(s) ------------------------
+    windows = _loop_windows(events)
+    wall = sum(
+        end.get("wall_s", end["t"] - start["t"]) for start, end in windows
+    )
+    steps = sum(
+        end.get("step", 0) - start.get("step", 0) for start, end in windows
+    )
+    spans = [e for e in events if e["ev"] == "span" and "dur_s" in e]
+    in_window = [
+        s for s in spans
+        if any(st["t"] <= s["t"] <= en["t"] for st, en in windows)
+    ]
+    cat_s = {c: 0.0 for c in LOOP_CATEGORIES}
+    for s in in_window:
+        if s.get("name") in cat_s:
+            cat_s[s["name"]] += s["dur_s"]
+    rep["loop"] = {
+        "windows": len(windows),
+        "truncated_windows": sum(
+            1 for _, end in windows if end.get("truncated")
+        ),
+        "wall_s": round(wall, 4),
+        "steps": steps,
+        "step_ms": round(wall / steps * 1e3, 2) if steps else None,
+    }
+    if wall > 0:
+        attributed = sum(cat_s.values())
+        breakdown = {
+            c: {"seconds": round(v, 4), "fraction": round(v / wall, 4)}
+            for c, v in cat_s.items()
+        }
+        other = max(wall - attributed, 0.0)
+        breakdown["other"] = {
+            "seconds": round(other, 4),
+            "fraction": round(other / wall, 4),
+        }
+        rep["breakdown"] = breakdown
+        rep["attributed_fraction"] = round(min(attributed / wall, 1.0), 4)
+
+    # --- input pipeline -----------------------------------------------------
+    depths = sorted(
+        e["value"] for e in events
+        if e["ev"] == "gauge" and e.get("name") == "prefetch_queue_depth"
+    )
+    if depths:
+        rep["prefetch_queue_depth"] = {
+            "n": len(depths),
+            "p10": _pct(depths, 10),
+            "p50": _pct(depths, 50),
+            "p90": _pct(depths, 90),
+            "max": depths[-1],
+        }
+    gen = sorted(
+        e["value"] for e in events
+        if e["ev"] == "gauge" and e.get("name") == "producer_batch_s"
+    )
+    if gen:
+        rep["producer_batch_s"] = {
+            "n": len(gen),
+            "mean": round(sum(gen) / len(gen), 4),
+            "p90": round(_pct(gen, 90), 4),
+            "max": round(gen[-1], 4),
+        }
+
+    # --- liveness / supervision --------------------------------------------
+    beats = [e for e in events if e["ev"] == "heartbeat"]
+    if beats:
+        ages = [e.get("age_s") for e in beats if e.get("age_s") is not None]
+        rep["heartbeat"] = {
+            "beats": len(beats),
+            "max_age_s": round(max(ages), 3) if ages else None,
+        }
+    sup = [e for e in events if e["ev"] == "supervisor"]
+    if sup:
+        phases = [e.get("phase") for e in sup]
+        rep["supervisor"] = {
+            "stalls": phases.count("stall"),
+            "restarts": phases.count("restart"),
+            "planned_restarts": phases.count("planned_restart"),
+            "timeline": [
+                {"t": round(e["t"], 3), "phase": e.get("phase"),
+                 **{k: v for k, v in e.items()
+                    if k not in ("t", "ev", "phase")}}
+                for e in sup
+            ],
+        }
+
+    # --- serving ------------------------------------------------------------
+    lat = sorted(
+        s["dur_s"] * 1e3 for s in spans if s.get("name") == "infer_batch"
+    )
+    if lat:
+        rep["serving_latency_ms"] = {
+            "batches": len(lat),
+            "rows": sum(
+                s.get("n", 0) for s in spans if s.get("name") == "infer_batch"
+            ),
+            "mean": round(sum(lat) / len(lat), 3),
+            "p50": round(_pct(lat, 50), 3),
+            "p90": round(_pct(lat, 90), 3),
+            "p99": round(_pct(lat, 99), 3),
+            "max": round(lat[-1], 3),
+        }
+
+    # --- warnings / metrics -------------------------------------------------
+    warns = [e for e in events if e["ev"] == "warning"]
+    if warns:
+        by_name: dict[str, int] = {}
+        for e in warns:
+            by_name[e.get("name", "?")] = by_name.get(e.get("name", "?"), 0) + 1
+        rep["warnings"] = by_name
+    metrics = [e for e in events if e["ev"] == "metrics"]
+    if metrics:
+        last: dict[str, dict] = {}
+        for e in metrics:
+            last[e.get("kind", "?")] = {
+                k: v for k, v in e.items() if k not in ("ev",)
+            }
+        rep["metrics"] = {"count": len(metrics), "last": last}
+    return rep
+
+
+def build_report_dir(run_dir: str) -> dict:
+    events, bad = load_events(run_dir)
+    return build_report(events, load_manifest(run_dir), bad_lines=bad)
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.3f}s" if v < 100 else f"{v:.1f}s"
+
+
+def format_report(rep: dict) -> str:
+    """Human-readable rendering (the CLI's default output; --json gives
+    the raw dict)."""
+    lines = []
+    run = rep.get("run") or {}
+    head = "run"
+    if run.get("config_name"):
+        head += f" [{run['config_name']}/{run.get('task')}]"
+    if run.get("start_time"):
+        head += f" started {run['start_time']}"
+    if run.get("device_count") is not None:
+        head += f", {run['device_count']} device(s)"
+    lines.append(head)
+    lines.append(
+        f"events: {rep['n_events']}"
+        + (f" ({rep['bad_lines']} unparseable)" if rep.get("bad_lines") else "")
+        + f", process starts: {rep.get('process_starts', 0)}"
+    )
+    loop = rep.get("loop", {})
+    if loop.get("wall_s"):
+        trunc = loop.get("truncated_windows", 0)
+        lines.append(
+            f"loop: {loop['steps']} step(s) over {loop['windows']} "
+            f"window(s), wall {_fmt_s(loop['wall_s'])}"
+            + (f", {loop['step_ms']} ms/step" if loop.get("step_ms") else "")
+            + (f" ({trunc} window(s) truncated by a kill)" if trunc else "")
+        )
+    bd = rep.get("breakdown")
+    if bd:
+        lines.append("step-time breakdown (fractions of loop wall):")
+        for name in (*LOOP_CATEGORIES, "other"):
+            row = bd[name]
+            lines.append(
+                f"  {name:<11} {row['seconds']:>9.3f}s  "
+                f"{row['fraction'] * 100:5.1f}%"
+            )
+        lines.append(
+            f"  attributed (non-other): "
+            f"{rep['attributed_fraction'] * 100:.1f}%"
+        )
+    q = rep.get("prefetch_queue_depth")
+    if q:
+        lines.append(
+            f"prefetch queue depth: p10 {q['p10']} p50 {q['p50']} "
+            f"p90 {q['p90']} max {q['max']} (n={q['n']})"
+        )
+    g = rep.get("producer_batch_s")
+    if g:
+        lines.append(
+            f"producer batch gen: mean {g['mean'] * 1e3:.1f} ms "
+            f"p90 {g['p90'] * 1e3:.1f} ms (n={g['n']})"
+        )
+    hb = rep.get("heartbeat")
+    if hb:
+        age = hb.get("max_age_s")
+        lines.append(
+            f"heartbeat: {hb['beats']} beat(s)"
+            + (f", max age {age}s" if age is not None else "")
+        )
+    sup = rep.get("supervisor")
+    if sup:
+        lines.append(
+            f"supervisor: {sup['stalls']} stall(s), {sup['restarts']} "
+            f"restart(s), {sup['planned_restarts']} planned"
+        )
+        for e in sup["timeline"]:
+            detail = {k: v for k, v in e.items() if k not in ("t", "phase")}
+            lines.append(f"  t={e['t']:.3f} {e['phase']} {detail or ''}")
+    sv = rep.get("serving_latency_ms")
+    if sv:
+        lines.append(
+            f"serving latency: {sv['batches']} batch(es), {sv['rows']} "
+            f"row(s); mean {sv['mean']} ms p50 {sv['p50']} ms "
+            f"p90 {sv['p90']} ms p99 {sv['p99']} ms max {sv['max']} ms"
+        )
+    w = rep.get("warnings")
+    if w:
+        lines.append(
+            "warnings: " + ", ".join(f"{k}×{v}" for k, v in sorted(w.items()))
+        )
+    m = rep.get("metrics")
+    if m:
+        lines.append(f"metrics records: {m['count']}")
+        for kind in sorted(m["last"]):
+            rec = m["last"][kind]
+            keep = {
+                k: rec[k]
+                for k in ("step", "loss", "accuracy", "samples_per_sec")
+                if k in rec
+            }
+            lines.append(f"  last {kind}: {json.dumps(keep)}")
+    return "\n".join(lines)

@@ -1,0 +1,111 @@
+"""Context-manager timing spans + Chrome trace export.
+
+A span times a named region and emits one ``span`` event on exit::
+
+    with obs.span("data_wait", take=4):
+        batches = tuple(next(stream) for _ in range(4))
+
+Emitted fields: ``name``, ``dur_s``, ``t`` (wall-clock *start*, so trace
+viewers place the interval correctly), ``thread`` (ident), ``parent`` (the
+enclosing span's name, tracked per-thread), plus any caller fields. The
+duration clock is ``perf_counter`` — monotonic, immune to NTP steps that
+would corrupt a wall-clock subtraction mid-run.
+
+Nesting is tracked in a thread-local stack, so producer threads, the train
+loop, and an eval pass each get independent, correctly-parented spans with
+no cross-thread locking beyond the sink's own line lock.
+
+When no run is active (``events._sink is None``) ``span()`` returns a
+shared no-op singleton: one ``None`` check, no clock reads, no allocation
+beyond the call itself — the hot dispatch loop pays nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from featurenet_tpu.obs import events as _events
+
+_tls = threading.local()
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "fields", "_t0", "_wall0")
+
+    def __init__(self, name: str, fields: dict):
+        self.name = name
+        self.fields = fields
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.name)
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        stack = _tls.stack
+        stack.pop()
+        _events.emit(
+            "span",
+            t=self._wall0,
+            name=self.name,
+            dur_s=dur,
+            thread=threading.get_ident(),
+            parent=stack[-1] if stack else None,
+            **self.fields,
+        )
+        return False
+
+
+def span(name: str, **fields):
+    """A timing span, or the shared no-op when no run is active."""
+    if _events._sink is None:
+        return _NULL
+    return _Span(name, fields)
+
+
+# --- Chrome trace export -----------------------------------------------------
+
+_SPAN_META = ("t", "ev", "name", "dur_s", "thread", "parent", "pid")
+
+
+def chrome_trace(events: list[dict]) -> dict:
+    """Fold ``span`` events into Chrome tracing's JSON object format
+    (load via chrome://tracing or https://ui.perfetto.dev). Complete
+    ("ph":"X") events, microsecond timestamps rebased to the earliest
+    event so the viewer opens at t=0; pid carries the emitting process
+    when recorded, tid the thread ident."""
+    spans = [e for e in events if e.get("ev") == "span" and "dur_s" in e]
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(e["t"] for e in spans)
+    out = []
+    for e in spans:
+        out.append({
+            "name": e.get("name", "?"),
+            "ph": "X",
+            "ts": (e["t"] - t0) * 1e6,
+            "dur": e["dur_s"] * 1e6,
+            "pid": e.get("pid", 0),
+            "tid": e.get("thread", 0),
+            "args": {k: v for k, v in e.items() if k not in _SPAN_META},
+        })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -152,6 +152,25 @@ def _annotate_delta(rows: list[dict], key: str) -> list[dict]:
     return rows
 
 
+def _annotate_rotation_control(rows: list[dict], key: str) -> list[dict]:
+    """Seg rotation rows compose a fixed ``ROTATION_PRESCALE`` shrink so
+    rotated stock stays in-grid — their clean-row delta therefore mixes
+    the rotation cost with the scale cost. The matching control is the
+    (scale, ROTATION_PRESCALE) row: ``delta_vs_scale_control`` is the
+    rotation-only attribution the artifact should carry (advisor r5)."""
+    control = next(
+        (r[key] for r in rows
+         if r["family"] == "scale" and r["level"] == ROTATION_PRESCALE),
+        None,
+    )
+    if control is None:
+        return rows
+    for r in rows:
+        if r["family"] == "rotation":
+            r["delta_vs_scale_control"] = round(r[key] - control, 4)
+    return rows
+
+
 def _perturb(family: str, level, grid: np.ndarray, rng) -> np.ndarray:
     g = grid.astype(bool)
     if family in ("clean", "tails"):
@@ -393,6 +412,12 @@ def evaluate_ood_seg(
         levels = [lv for lv in levels if lv[0] in families]
     if ("clean", None) not in levels:
         levels.insert(0, ("clean", None))
+    # Rotation rows are only interpretable against their pre-scale
+    # control: force the (scale, ROTATION_PRESCALE) row into the report
+    # whenever any rotation row runs (e.g. --families rotation).
+    if (any(lv[0] == "rotation" for lv in levels)
+            and ("scale", ROTATION_PRESCALE) not in levels):
+        levels.append(("scale", ROTATION_PRESCALE))
 
     rows = []
     for family, level in levels:
@@ -432,7 +457,9 @@ def evaluate_ood_seg(
             "mean_iou": round(float(iou.sum() / max(present.sum(), 1)), 4),
             "voxel_accuracy": round(float(correct / total), 4),
         })
-    return _annotate_delta(rows, "mean_iou")
+    return _annotate_rotation_control(
+        _annotate_delta(rows, "mean_iou"), "mean_iou"
+    )
 
 
 def main(argv=None) -> None:

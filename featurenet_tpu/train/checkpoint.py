@@ -15,6 +15,7 @@ from typing import Optional
 import jax
 import orbax.checkpoint as ocp
 
+from featurenet_tpu import obs
 from featurenet_tpu.train.state import TrainState
 
 # Run-config sidecar written into the checkpoint directory: the checkpoint's
@@ -66,7 +67,10 @@ class CheckpointManager:
             "batch_stats": state.batch_stats,
             "opt_state": state.opt_state,
         }
-        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        # Async save: this span is the host-blocking enqueue only; the
+        # background write's completion is bounded by checkpoint_wait.
+        with obs.span("checkpoint_save", step=step):
+            self._mgr.save(step, args=ocp.args.StandardSave(payload))
 
     def restore(self, state: TrainState, step: Optional[int] = None) -> TrainState:
         """Restore into the shardings/dtypes of the live ``state`` template."""
@@ -80,7 +84,10 @@ class CheckpointManager:
             "opt_state": state.opt_state,
         }
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        with obs.span("checkpoint_restore", step=int(step)):
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
         return state.replace(**restored)
 
     def restore_init(
@@ -100,7 +107,8 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        with obs.span("checkpoint_wait"):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.close()

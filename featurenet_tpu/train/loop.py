@@ -17,11 +17,13 @@ Reference parity: ``train.py`` ``main()`` (SURVEY.md §3.1), redesigned:
 from __future__ import annotations
 
 import collections
+import time
 from typing import Optional
 
 import jax
 import numpy as np
 
+from featurenet_tpu import obs
 from featurenet_tpu.config import Config
 from featurenet_tpu.data.dataset import (
     SyntheticVoxelDataset,
@@ -86,6 +88,15 @@ def build_model(cfg: Config):
 class Trainer:
     def __init__(self, cfg: Config, mesh=None, spatial: Optional[bool] = None):
         self.cfg = cfg.validate()
+        # Run-scoped event log (featurenet_tpu.obs): installed first so
+        # every later warning/span of this construction is captured. Host 0
+        # only — a multi-process run would interleave per-host logs into
+        # one file (per-host merge is a roadmap follow-on).
+        if self.cfg.run_dir and jax.process_index() == 0:
+            from featurenet_tpu.config import config_to_dict
+
+            obs.init_run(self.cfg.run_dir,
+                         config=config_to_dict(self.cfg))
         if mesh is not None:
             self.mesh = mesh
         else:
@@ -94,14 +105,12 @@ class Trainer:
                 # Presets carry pod-scale mesh shapes; on smaller hardware
                 # degrade to the widest feasible model axis instead of
                 # refusing to start.
-                import json as _json
-                import sys as _sys
-
-                print(_json.dumps({
-                    "mesh_warning": f"mesh_model={cfg.mesh_model} does not "
-                    f"divide the {len(jax.devices())} available device(s); "
-                    f"running with mesh_model={model}",
-                }), file=_sys.stderr)
+                obs.warn(
+                    "mesh_warning",
+                    f"mesh_model={cfg.mesh_model} does not divide the "
+                    f"{len(jax.devices())} available device(s); running "
+                    f"with mesh_model={model}",
+                )
             self.mesh = make_mesh(cfg.mesh_data, model)
         self.spatial = cfg.spatial if spatial is None else spatial
         self.model = build_model(cfg)
@@ -200,7 +209,12 @@ class Trainer:
         # (ops/membytes.py): the k-fused executable's peak grows ~linearly
         # with k, and the best seg64 model once lost 8× of its dispatch
         # amortization to a hand-resolved compile-time OOM. Degrade with a
-        # warning — never crash, never silently under-dispatch.
+        # warning — never crash, never silently under-dispatch. The clamp
+        # governs preset-derived defaults only: cfg.clamp_dispatch_k=False
+        # (set by the CLI for an explicit --steps-per-dispatch) honors the
+        # requested k, warning that it exceeds the first-order model —
+        # the model is first-order, and opting out of it is the operator's
+        # call (advisor r5).
         self._k = max(1, cfg.steps_per_dispatch)
         if self._k > 1:
             from featurenet_tpu.ops.membytes import max_feasible_k
@@ -208,17 +222,22 @@ class Trainer:
             k_fit = max_feasible_k(
                 cfg, self.params_n, n_rows=_hbm_rows_estimate(cfg)
             )
-            if k_fit < self._k:
-                import json as _json
-                import sys as _sys
-
-                print(_json.dumps({
-                    "dispatch_warning": f"steps_per_dispatch="
-                    f"{cfg.steps_per_dispatch} does not fit the analytic "
-                    f"HBM byte model for this config; clamped to {k_fit} "
-                    "(ops/membytes.max_feasible_k)",
-                }), file=_sys.stderr)
+            if k_fit < self._k and cfg.clamp_dispatch_k:
+                obs.warn(
+                    "dispatch_warning",
+                    f"steps_per_dispatch={cfg.steps_per_dispatch} does not "
+                    f"fit the analytic HBM byte model for this config; "
+                    f"clamped to {k_fit} (ops/membytes.max_feasible_k)",
+                )
                 self._k = k_fit
+            elif k_fit < self._k:
+                obs.warn(
+                    "dispatch_warning",
+                    f"steps_per_dispatch={cfg.steps_per_dispatch} exceeds "
+                    f"the analytic HBM byte model's k={k_fit} but was "
+                    "requested explicitly (clamp_dispatch_k=False); "
+                    "honoring it — the fused executable may OOM",
+                )
         if self._k > 1:
             self._multi_step = jax.jit(
                 make_multi_train_step(
@@ -403,6 +422,11 @@ class Trainer:
         readback / eval / checkpoint) — never on mere dispatch, which
         succeeds even when the backend is hung.
         """
+        now = time.time()
+        last = getattr(self, "_last_beat", None)
+        obs.emit("heartbeat",
+                 age_s=round(now - last, 3) if last is not None else None)
+        self._last_beat = now
         if self.cfg.heartbeat_file:
             from featurenet_tpu.train.supervisor import touch_heartbeat
 
@@ -422,18 +446,28 @@ class Trainer:
         """
         if self._hbm:
             fn = self._hbm_step_k if take == self._k else self._hbm_step_1
-            self.state, metrics = fn(
-                self.state, self._hbm_data, self._hbm_labels, self._step_rng
-            )
+            with obs.span("dispatch", take=take, mode="hbm"):
+                self.state, metrics = fn(
+                    self.state, self._hbm_data, self._hbm_labels,
+                    self._step_rng,
+                )
         elif take > 1:
-            batches = tuple(next(stream) for _ in range(take))
-            self.state, metrics = self._multi_step(
-                self.state, batches, self._step_rng
-            )
+            # data_wait is the host blocking on the prefetcher (starved
+            # input pipeline); dispatch is the enqueue of the fused
+            # executable — actual device time surfaces at the readback.
+            with obs.span("data_wait", take=take):
+                batches = tuple(next(stream) for _ in range(take))
+            with obs.span("dispatch", take=take):
+                self.state, metrics = self._multi_step(
+                    self.state, batches, self._step_rng
+                )
         else:
-            self.state, metrics = self._train_step(
-                self.state, next(stream), self._step_rng
-            )
+            with obs.span("data_wait", take=1):
+                batch = next(stream)
+            with obs.span("dispatch", take=1):
+                self.state, metrics = self._train_step(
+                    self.state, batch, self._step_rng
+                )
         return metrics
 
     def recalibrate_bn(self, batches: int = 64) -> None:
@@ -446,11 +480,25 @@ class Trainer:
         over the mix; eval/serving on the clean modality then pays an
         eval-only accuracy tax — the same mechanism the round-4 recipe
         study identified during high-lr phases (BASELINE.md). The host
-        stream used here is the UN-augmented cache/synthetic feed (device
-        augmentation lives inside the train step, which this never calls).
+        stream used here is guaranteed UN-augmented: when this Trainer's
+        host data path applies augmentation in its workers (streamed
+        segment, host-augmented classify), a clean shallow clone of the
+        dataset feeds this pass instead — so API callers get the same
+        clean-stream guarantee the CLI ``recalibrate`` command enforces
+        by rebuilding the config (advisor r5). Device augmentation lives
+        inside the train step, which this never calls.
         """
         from featurenet_tpu.parallel.mesh import replicated as _rep
         from featurenet_tpu.train.steps import _batch_voxels
+
+        data = self.train_data
+        if getattr(data, "augment", False):
+            # Cache datasets read self.augment per gather; a shallow copy
+            # shares the mmapped shards and costs nothing.
+            import copy
+
+            data = copy.copy(data)
+            data.augment = False
 
         def fwd(params, stats, batch, rng):
             _, mutated = self.model.apply(
@@ -475,7 +523,7 @@ class Trainer:
         # on one fixed realization. Jitted like _step_rng itself — eager key
         # ops on a replicated multi-process array would fail.
         fold = jax.jit(jax.random.fold_in)
-        it = self.train_data.worker_iter(0, 1)
+        it = data.worker_iter(0, 1)
         stats = self.state.batch_stats
         for i in range(batches):
             batch = put_batch(next(it), self.batch_sh)
@@ -493,6 +541,10 @@ class Trainer:
         return 0
 
     def evaluate(self) -> dict[str, float]:
+        with obs.span("eval"):
+            return self._evaluate()
+
+    def _evaluate(self) -> dict[str, float]:
         if hasattr(self.eval_data, "epoch_batches"):
             # Cache-backed: one exact pass over the held-out split, sharded
             # across hosts — host i feeds the i-th decimation of the split
@@ -549,6 +601,10 @@ class Trainer:
             num_workers=cfg.data_workers,
         )
         self.logger.start_window()
+        # Loop window markers: the report attributes span time to the
+        # step-time breakdown only between these two events.
+        obs.emit("loop_start", step=start, stop=stop, total=total)
+        loop_t0 = time.perf_counter()
         last = {}
         # Resume-safe profiling window: anchored at the first step this run
         # actually executes, and always closed before the loop exits.
@@ -574,7 +630,8 @@ class Trainer:
                 new_step = step + take
                 pending.append(metrics["loss"])
                 if len(pending) > max(cfg.max_inflight_steps // take, 1):
-                    float(pending.popleft())  # readback = proof of progress
+                    with obs.span("readback", step=new_step):
+                        float(pending.popleft())  # readback = progress proof
                     self._heartbeat()
                 if trace_active and (
                     new_step >= trace_start + cfg.profile_steps
@@ -605,10 +662,13 @@ class Trainer:
                     self._heartbeat()
                 if self.ckpt and (crossed(cfg.checkpoint_every)
                                   or new_step == total):
-                    self.ckpt.save(self.state)
+                    with obs.span("checkpoint", step=new_step):
+                        self.ckpt.save(self.state)
                     self._heartbeat()
                 step = new_step
         finally:
+            obs.emit("loop_end", step=int(step),
+                     wall_s=time.perf_counter() - loop_t0)
             if stream is not None:
                 # Stop the producer threads and release their lookahead of
                 # device_put batches — a returned run must not keep pinning

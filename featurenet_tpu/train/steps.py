@@ -340,13 +340,25 @@ def make_hbm_multi_train_step(
             jnp.take(targets_local, idx, axis=0),
         )
 
-    shard_draw = jax.shard_map(
-        draw,
+    # Version span: the function lives at jax.shard_map on new releases and
+    # jax.experimental.shard_map on old ones, and the replication-check
+    # knob was renamed check_rep -> check_vma partway through — with both
+    # spellings co-existing under jax.shard_map for some versions. Probe by
+    # calling (TypeError = wrong spelling for this version), not by
+    # attribute presence, so mid-era releases resolve correctly too.
+    if hasattr(jax, "shard_map"):
+        shard_map_fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as shard_map_fn
+    shard_kw = dict(
         mesh=mesh,
         in_specs=(P(), P("data"), P("data")),
         out_specs=(P("data"), P("data")),
-        check_vma=False,
     )
+    try:
+        shard_draw = shard_map_fn(draw, check_vma=False, **shard_kw)
+    except TypeError:
+        shard_draw = shard_map_fn(draw, check_rep=False, **shard_kw)
 
     def multi_step(state: TrainState, data, targets, rng):
         metrics = None

@@ -78,6 +78,7 @@ def supervise(
     poll_s: float = 5.0,
     grace_s: Optional[float] = None,
     log=print,
+    run_dir: Optional[str] = None,
 ) -> SuperviseResult:
     """Run ``argv`` under stall supervision; restart on stall or crash.
 
@@ -94,10 +95,27 @@ def supervise(
       grace_s: stall clock allowance for the child's cold start (compile can
         dwarf a step); defaults to ``max(stall_timeout_s, 600)``.
       log: sink for one-line JSON status records.
+      run_dir: when set (the child's ``--run-dir``), every supervisor
+        decision — spawn, stall verdict, restart, giving up — is also
+        appended as a ``supervisor`` event to the run's shared
+        ``events.jsonl``, so ``cli report`` reconstructs the restart/stall
+        timeline next to the child's own spans. Appends are line-atomic
+        across processes (obs.events), so the two writers interleave
+        safely.
 
     Returns a ``SuperviseResult``; ``exit_code`` 0 means the child finished.
     """
     grace = grace_s if grace_s is not None else max(stall_timeout_s, 600.0)
+
+    sink = None
+    if run_dir:
+        from featurenet_tpu.obs.events import EventSink
+
+        sink = EventSink(run_dir)
+
+    def record(phase: str, **fields) -> None:
+        if sink is not None:
+            sink.emit("supervisor", phase=phase, **fields)
 
     restarts = stalls = planned = 0
     # Consecutive nonzero exits before any heartbeat: a child that dies
@@ -118,6 +136,7 @@ def supervise(
         proc = subprocess.Popen(list(argv), start_new_session=True)
         log(json.dumps({"supervisor": "spawn", "pid": proc.pid,
                         "attempt": restarts + 1}))
+        record("spawn", pid=proc.pid, attempt=restarts + 1)
         stalled = False
         while True:
             rc = proc.poll()
@@ -147,6 +166,7 @@ def supervise(
                     "supervisor": "stall", "pid": proc.pid,
                     "heartbeat_age_s": round(age, 1),
                 }))
+                record("stall", pid=proc.pid, heartbeat_age_s=round(age, 1))
                 _kill_tree(proc)
                 rc = proc.returncode
                 break
@@ -163,6 +183,9 @@ def supervise(
         if not stalled and rc == 0:
             log(json.dumps({"supervisor": "done", "restarts": restarts,
                             "stalls": stalls, "planned": planned}))
+            record("done", restarts=restarts, stalls=stalls, planned=planned)
+            if sink is not None:
+                sink.close()
             return SuperviseResult(0, restarts, stalls, planned)
         if not stalled and rc == RESTART_EXIT_CODE and first_beat_seen:
             # Planned restart: the child checkpointed and asked for a fresh
@@ -176,6 +199,7 @@ def supervise(
             early_fails = 0
             log(json.dumps({"supervisor": "planned_restart",
                             "count": planned}))
+            record("planned_restart", count=planned)
             continue
         if not stalled and not first_beat_seen:
             early_fails += 1
@@ -186,6 +210,10 @@ def supervise(
                               "deterministic startup failure",
                     "restarts": restarts, "stalls": stalls,
                 }))
+                record("giving_up", reason=f"exit_{rc} before first "
+                       "heartbeat, twice", restarts=restarts, stalls=stalls)
+                if sink is not None:
+                    sink.close()
                 return SuperviseResult(rc if rc else 1, restarts, stalls,
                                        planned)
         else:
@@ -195,10 +223,16 @@ def supervise(
         if restarts > max_restarts:
             log(json.dumps({"supervisor": "giving_up", "restarts": restarts - 1,
                             "stalls": stalls, "last_exit": rc}))
+            record("giving_up", restarts=restarts - 1, stalls=stalls,
+                   last_exit=rc)
+            if sink is not None:
+                sink.close()
             return SuperviseResult(rc if rc else 1, restarts - 1, stalls,
                                    planned)
         log(json.dumps({"supervisor": "restart", "attempt": restarts + 1,
                         "reason": "stall" if stalled else f"exit_{rc}"}))
+        record("restart", attempt=restarts + 1,
+               reason="stall" if stalled else f"exit_{rc}")
 
 
 def child_argv_from_cli(argv: Sequence[str], heartbeat_file: str) -> list[str]:

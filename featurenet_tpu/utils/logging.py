@@ -20,6 +20,8 @@ from typing import Any
 import jax
 import numpy as np
 
+from featurenet_tpu import obs
+
 
 class MetricLogger:
     def __init__(self, stream=None, tb_dir: str | None = None):
@@ -41,8 +43,11 @@ class MetricLogger:
         self._window_samples += n
 
     def log(self, step: int, metrics: dict, prefix: str = "train") -> dict:
-        # Wall the async stream: metrics must be real before we read the clock.
-        metrics = jax.block_until_ready(metrics)
+        # Wall the async stream: metrics must be real before we read the
+        # clock. In an obs run this wait is attributed as device time —
+        # it is where the host blocks on outstanding execution.
+        with obs.span("readback", src="metrics", step=int(step)):
+            metrics = jax.block_until_ready(metrics)
         record: dict[str, Any] = {"step": int(step), "kind": prefix}
         for k, v in metrics.items():
             a = np.asarray(v)
@@ -53,6 +58,9 @@ class MetricLogger:
             self.start_window()
         self.history.append(record)
         print(json.dumps(record), file=self.stream, flush=True)
+        # Mirror into the run-scoped event log (no-op without a run_dir):
+        # one artifact then holds metrics AND timing/liveness events.
+        obs.emit("metrics", **record)
         if self._tb is not None:
             scalars = {
                 f"{prefix}/{k}": v

@@ -29,13 +29,32 @@ pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 over = json.loads(sys.argv[4])
 want_eval = over.pop("_eval", False)
 ndev = over.pop("_devices", 2)
+import os
+# Portable device-count forcing: the jax_num_cpu_devices config option
+# only exists on newer jax (and rejects being combined with this flag);
+# the XLA flag alone works everywhere and must be set before backend init.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={ndev}"
+).strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", ndev)
+try:
+    # 0.4.x CPU backends refuse multiprocess computations unless the gloo
+    # collectives implementation is selected; newer jax dropped the knob.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
 repo = os.environ["PYTHONPATH"].split(os.pathsep)[0]  # set by the test
 cache_dir = os.path.join(repo, ".cache", "jax_compile")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+if over.pop("_no_cache", False):
+    # Executing an executable DESERIALIZED from the persistent cache can
+    # fatally abort in this sandbox (the AOT-loader machine-feature issue
+    # conftest.py documents); a respawned segment hits exactly that, so
+    # resume tests compile fresh.
+    jax.config.update("jax_enable_compilation_cache", False)
 jax.distributed.initialize(
     coordinator_address=f"127.0.0.1:{port}",
     num_processes=nproc,
@@ -285,8 +304,12 @@ def test_multiprocess_checkpoint_resume_and_planned_restart(tmp_path):
     handoff (the harness plays the per-deployment supervisor)."""
     nproc = 2
     ckpt = str(tmp_path / "ck")
+    # _no_cache: the resumed group would execute train steps deserialized
+    # from the persistent compile cache (written by segment 1), which can
+    # fatally abort in this sandbox — compile fresh instead (see _WORKER).
     over = {"global_batch": 8, "total_steps": 5, "checkpoint_every": 2,
-            "checkpoint_dir": ckpt, "restart_every_steps": 3}
+            "checkpoint_dir": ckpt, "restart_every_steps": 3,
+            "_no_cache": True}
     # Segment 1: trains to step 3, saves, exits RESTART_EXIT_CODE (75).
     outs, codes = _retry_port(nproc, over)
     assert codes == [75] * nproc, (codes, [o[-1500:] for o in outs])

@@ -1,0 +1,419 @@
+"""Observability layer: event schema, span nesting/thread-safety, report
+aggregation, and the end-to-end Trainer ``run_dir`` contract — plus the
+advisor-r5 satellite fixes that rode along with the obs PR (explicit
+dispatch-k honor, clean-stream recalibration, seg-OOD rotation controls,
+recalibrate dropping a stale ``init_from``)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from featurenet_tpu import obs
+from featurenet_tpu.config import get_config
+from featurenet_tpu.obs.report import (
+    build_report,
+    build_report_dir,
+    load_events,
+)
+from featurenet_tpu.obs.spans import chrome_trace
+from featurenet_tpu.train import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _isolated_sink():
+    """Obs state is process-wide; no test may leak an active sink into the
+    rest of the suite (every other test file runs without a run_dir and
+    must stay on the zero-overhead null path)."""
+    obs.close_run()
+    yield
+    obs.close_run()
+
+
+def test_events_schema_roundtrip(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, config={"name": "unit", "task": "classify"})
+    obs.gauge("prefetch_queue_depth", 3, worker=1)
+    obs.emit("heartbeat", age_s=0.5)
+    obs.warn("mesh_warning", "degraded", requested=8)
+    obs.close_run()
+
+    manifest = json.load(open(os.path.join(run_dir, "run.json")))
+    assert manifest["config"]["name"] == "unit"
+    assert "start_time" in manifest and "argv" in manifest
+    assert "process_index" in manifest["jax"]
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    assert [e["ev"] for e in events] == [
+        "run_start", "gauge", "heartbeat", "warning"
+    ]
+    for e in events:
+        assert isinstance(e["t"], float)
+    g = events[1]
+    assert (g["name"], g["value"], g["worker"]) == (
+        "prefetch_queue_depth", 3, 1
+    )
+    assert events[3]["msg"] == "degraded"
+
+    # Re-init of the same dir appends (restart semantics) and keeps the
+    # original manifest rather than rewriting it.
+    start0 = manifest["start_unix"]
+    obs.init_run(run_dir, config={"name": "other"})
+    obs.close_run()
+    manifest2 = json.load(open(os.path.join(run_dir, "run.json")))
+    assert manifest2["start_unix"] == start0
+    events2, _ = load_events(run_dir)
+    assert sum(1 for e in events2 if e["ev"] == "run_start") == 2
+
+
+def test_span_nesting_and_thread_safety(tmp_path):
+    obs.init_run(str(tmp_path / "run"))
+    with obs.span("outer", take=4):
+        with obs.span("inner"):
+            pass
+
+    # Hammer the sink from 8 threads: every line must land whole (the
+    # lock serializes writers) and each thread's parent tracking must be
+    # independent (thread-local stacks).
+    def worker(i):
+        for j in range(50):
+            with obs.span("t_outer", thread_no=i):
+                with obs.span("t_inner", j=j):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    obs.close_run()
+
+    events, bad = load_events(str(tmp_path / "run"))
+    assert bad == 0
+    spans = [e for e in events if e["ev"] == "span"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert len(by_name["t_outer"]) == 400
+    assert len(by_name["t_inner"]) == 400
+    assert by_name["inner"][0]["parent"] == "outer"
+    assert by_name["outer"][0]["parent"] is None
+    assert by_name["outer"][0]["take"] == 4
+    assert all(s["parent"] == "t_outer" for s in by_name["t_inner"])
+    assert all(s["dur_s"] >= 0 for s in spans)
+
+    # Chrome export: one complete event per span, rebased to t=0.
+    trace = chrome_trace(events)
+    assert len(trace["traceEvents"]) == len(spans)
+    assert all(ev["ph"] == "X" and ev["ts"] >= 0
+               for ev in trace["traceEvents"])
+
+
+def test_inactive_sink_is_noop():
+    assert not obs.active()
+    # No exceptions, no files, and the null span is a shared singleton —
+    # the hot loop pays one None check, not an allocation.
+    obs.emit("anything", x=1)
+    obs.gauge("g", 2)
+    assert obs.span("a") is obs.span("b")
+    with obs.span("a"):
+        pass
+
+
+def test_report_aggregation_synthetic():
+    t0 = 1000.0
+    ev = [
+        {"t": t0, "ev": "run_start", "pid": 1},
+        {"t": t0, "ev": "loop_start", "step": 0, "stop": 4, "total": 4},
+        {"t": t0 + 0.1, "ev": "span", "name": "data_wait", "dur_s": 0.5},
+        {"t": t0 + 0.6, "ev": "span", "name": "dispatch", "dur_s": 0.2},
+        {"t": t0 + 0.8, "ev": "span", "name": "readback", "dur_s": 0.1},
+        {"t": t0 + 0.9, "ev": "span", "name": "eval", "dur_s": 0.5},
+        {"t": t0 + 1.4, "ev": "span", "name": "checkpoint", "dur_s": 0.2},
+        {"t": t0 + 0.2, "ev": "gauge", "name": "prefetch_queue_depth",
+         "value": 0},
+        {"t": t0 + 0.3, "ev": "gauge", "name": "prefetch_queue_depth",
+         "value": 2},
+        {"t": t0 + 0.4, "ev": "gauge", "name": "prefetch_queue_depth",
+         "value": 4},
+        {"t": t0 + 0.2, "ev": "gauge", "name": "producer_batch_s",
+         "value": 0.05, "worker": 0},
+        {"t": t0 + 0.5, "ev": "heartbeat", "age_s": 1.0},
+        {"t": t0 + 1.5, "ev": "heartbeat", "age_s": 5.0},
+        {"t": t0 + 1.6, "ev": "supervisor", "phase": "spawn", "pid": 7},
+        {"t": t0 + 1.7, "ev": "supervisor", "phase": "stall",
+         "heartbeat_age_s": 700.0},
+        {"t": t0 + 1.8, "ev": "supervisor", "phase": "restart",
+         "attempt": 2, "reason": "stall"},
+        {"t": t0 + 2.0, "ev": "loop_end", "step": 4, "wall_s": 2.0},
+        # Serving spans live outside any loop window.
+        {"t": t0 + 3.0, "ev": "span", "name": "infer_batch", "dur_s": 0.010,
+         "n": 32},
+        {"t": t0 + 3.1, "ev": "span", "name": "infer_batch", "dur_s": 0.030,
+         "n": 8},
+        {"t": t0 + 3.2, "ev": "metrics", "kind": "train", "step": 4,
+         "loss": 0.5},
+    ]
+    rep = build_report(ev)
+    assert rep["loop"] == {
+        "windows": 1, "truncated_windows": 0, "wall_s": 2.0, "steps": 4,
+        "step_ms": 500.0,
+    }
+    bd = rep["breakdown"]
+    assert bd["data_wait"]["fraction"] == 0.25
+    assert bd["dispatch"]["fraction"] == 0.1
+    assert bd["readback"]["fraction"] == 0.05
+    assert bd["eval"]["fraction"] == 0.25
+    assert bd["checkpoint"]["fraction"] == 0.1
+    assert bd["other"]["fraction"] == 0.25
+    assert sum(v["fraction"] for v in bd.values()) == pytest.approx(1.0)
+    assert rep["attributed_fraction"] == 0.75
+    assert rep["prefetch_queue_depth"]["p50"] == 2
+    assert rep["prefetch_queue_depth"]["max"] == 4
+    assert rep["producer_batch_s"]["n"] == 1
+    assert rep["heartbeat"] == {"beats": 2, "max_age_s": 5.0}
+    sup = rep["supervisor"]
+    assert (sup["stalls"], sup["restarts"]) == (1, 1)
+    assert [e["phase"] for e in sup["timeline"]] == [
+        "spawn", "stall", "restart"
+    ]
+    sv = rep["serving_latency_ms"]
+    assert sv["batches"] == 2 and sv["rows"] == 40
+    assert sv["max"] == 30.0
+    assert rep["metrics"]["last"]["train"]["loss"] == 0.5
+
+
+def test_report_truncated_window_still_attributes():
+    """A loop_start with no loop_end (the run was SIGKILLed mid-loop — the
+    supervisor's stall verdict) must still get a breakdown, bounded at the
+    last event: that segment is exactly the one the operator diagnoses."""
+    t0 = 2000.0
+    ev = [
+        {"t": t0, "ev": "loop_start", "step": 0, "stop": 8, "total": 8},
+        {"t": t0 + 0.1, "ev": "span", "name": "data_wait", "dur_s": 0.8},
+        {"t": t0 + 0.9, "ev": "span", "name": "dispatch", "dur_s": 0.1},
+        {"t": t0 + 1.0, "ev": "metrics", "kind": "train", "step": 3,
+         "loss": 1.0},
+        {"t": t0 + 2.0, "ev": "heartbeat", "age_s": 1.0},
+    ]
+    rep = build_report(ev)
+    assert rep["loop"]["windows"] == 1
+    assert rep["loop"]["truncated_windows"] == 1
+    assert rep["loop"]["steps"] == 3  # highest step any event reported
+    assert rep["loop"]["wall_s"] == pytest.approx(2.0)
+    assert rep["breakdown"]["data_wait"]["fraction"] == pytest.approx(0.4)
+
+    # Mid-sequence kill: a respawn's loop_start closes the dead segment's
+    # window at the respawn boundary, so its spans stay attributed.
+    t1 = t0 + 10.0
+    ev2 = ev + [
+        {"t": t1, "ev": "loop_start", "step": 3, "stop": 8, "total": 8},
+        {"t": t1 + 0.5, "ev": "span", "name": "dispatch", "dur_s": 0.5},
+        {"t": t1 + 1.0, "ev": "loop_end", "step": 8, "wall_s": 1.0},
+    ]
+    rep2 = build_report(ev2)
+    assert rep2["loop"]["windows"] == 2
+    assert rep2["loop"]["truncated_windows"] == 1
+    # Killed window closes at the respawn's t (wall 10s), clean one at 1s.
+    assert rep2["loop"]["wall_s"] == pytest.approx(11.0)
+    assert rep2["loop"]["steps"] == 3 + 5
+    assert rep2["breakdown"]["data_wait"]["seconds"] == pytest.approx(0.8)
+    assert rep2["breakdown"]["dispatch"]["seconds"] == pytest.approx(0.6)
+
+
+def test_trainer_run_dir_end_to_end(tmp_path, capsys):
+    """The acceptance contract: a 2-step CPU run with run_dir produces
+    run.json + events.jsonl, and the report's attributed fractions
+    (data_wait + dispatch + readback + eval + checkpoint + other) account
+    for >= 90% of loop wall time."""
+    run_dir = str(tmp_path / "run")
+    cfg = get_config(
+        "smoke16",
+        total_steps=2,
+        log_every=1,
+        eval_every=2,
+        checkpoint_every=2,
+        eval_batches=1,
+        data_workers=1,
+        global_batch=8,
+        run_dir=run_dir,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        heartbeat_file=str(tmp_path / "hb"),
+    )
+    t = Trainer(cfg)
+    t.run()
+    obs.close_run()
+
+    assert os.path.exists(os.path.join(run_dir, "run.json"))
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    kinds = {e["ev"] for e in events}
+    assert {"run_start", "loop_start", "loop_end", "span", "gauge",
+            "metrics", "heartbeat"} <= kinds
+    names = {e.get("name") for e in events if e["ev"] == "span"}
+    assert {"data_wait", "dispatch", "eval", "checkpoint",
+            "checkpoint_save"} <= names
+    assert any(
+        e["ev"] == "gauge" and e["name"] == "prefetch_queue_depth"
+        for e in events
+    )
+
+    rep = build_report_dir(run_dir)
+    assert rep["loop"]["steps"] == 2
+    assert rep["loop"]["wall_s"] > 0
+    fracs = {k: v["fraction"] for k, v in rep["breakdown"].items()}
+    assert sum(fracs.values()) >= 0.90
+    assert rep["metrics"]["count"] >= 2  # setup + train/eval records
+
+    # The CLI entry prints a parseable breakdown from the same artifact.
+    from featurenet_tpu.cli import main as cli_main
+
+    trace_path = str(tmp_path / "trace.json")
+    cli_main(["report", run_dir, "--trace", trace_path])
+    out = capsys.readouterr().out
+    assert "step-time breakdown" in out
+    assert "data_wait" in out and "checkpoint" in out
+    trace = json.load(open(trace_path))
+    assert trace["traceEvents"], "spans must export as Chrome trace events"
+    cli_main(["report", run_dir, "--json"])
+    rep2 = json.loads(capsys.readouterr().out)
+    assert rep2["breakdown"].keys() == rep["breakdown"].keys()
+
+
+def test_run_without_run_dir_stays_dark(tmp_path):
+    """No run_dir => the obs layer never activates: no sink, no obs files,
+    the dispatch path keeps its null-span fast path."""
+    cfg = get_config(
+        "smoke16", total_steps=1, log_every=1, eval_every=10**9,
+        checkpoint_every=10**9, eval_batches=1, data_workers=1,
+        global_batch=8,
+    )
+    assert not obs.active()
+    Trainer(cfg).run()
+    assert not obs.active()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_explicit_dispatch_k_is_honored(monkeypatch, capsys):
+    """clamp_dispatch_k=False (the CLI's behavior for an explicit
+    --steps-per-dispatch) keeps the requested k even when the membytes
+    model says it does not fit — with the warning still emitted."""
+    from featurenet_tpu.ops import membytes
+
+    monkeypatch.setattr(membytes, "HBM_BYTES", 1e6)  # nothing >k=1 fits
+    base = dict(steps_per_dispatch=2, total_steps=2, data_workers=1,
+                eval_batches=1, global_batch=8)
+    clamped = Trainer(get_config("smoke16", **base))
+    assert clamped._k == 1
+    assert "dispatch_warning" in capsys.readouterr().err
+    pinned = Trainer(get_config("smoke16", clamp_dispatch_k=False, **base))
+    assert pinned._k == 2
+    err = capsys.readouterr().err
+    assert "dispatch_warning" in err and "honoring" in err
+
+
+def test_cli_sets_clamp_false_for_explicit_k():
+    from featurenet_tpu.cli import _overrides
+
+    class A:
+        steps_per_dispatch = 4
+        total_steps = 2
+
+    over = _overrides(A())
+    assert over["steps_per_dispatch"] == 4
+    assert over["clamp_dispatch_k"] is False
+
+    class B:
+        total_steps = 2
+
+    assert "clamp_dispatch_k" not in _overrides(B())
+
+
+def test_recalibrate_bn_clean_stream_clone(tmp_path):
+    """An API caller whose Trainer streams HOST-augmented batches still
+    gets clean-stream recalibration: the pass feeds a non-augmenting
+    clone and never mutates the training dataset."""
+    from featurenet_tpu.data.offline import export_synthetic_cache
+
+    cache = str(tmp_path / "cache")
+    export_synthetic_cache(cache, per_class=3, resolution=16)
+    cfg = get_config(
+        "smoke16", data_cache=cache, augment=True, augment_device=False,
+        total_steps=2, data_workers=1, eval_batches=1, global_batch=8,
+    )
+    t = Trainer(cfg)
+    assert t.train_data.augment is True  # host-side augmentation active
+    stats_before = [
+        np.asarray(x) for x in
+        __import__("jax").tree_util.tree_leaves(t.state.batch_stats)
+    ]
+    t.recalibrate_bn(batches=2)
+    assert t.train_data.augment is True  # clone, not mutation
+    import jax
+
+    assert any(
+        not np.array_equal(a, np.asarray(b))
+        for a, b in zip(stats_before,
+                        jax.tree_util.tree_leaves(t.state.batch_stats))
+    )
+
+
+def test_recalibrate_cli_drops_stale_init_from(tmp_path):
+    """A checkpoint whose persisted config carries init_from must not
+    re-run (or crash on) the warm-start restore during recalibration —
+    the weights come from checkpoint_dir (advisor r5)."""
+    from featurenet_tpu.cli import main as cli_main
+
+    src = str(tmp_path / "src")
+    cfg = get_config(
+        "smoke16", total_steps=2, eval_every=10**9, checkpoint_every=2,
+        log_every=1, data_workers=1, eval_batches=1, checkpoint_dir=src,
+        global_batch=8,
+    )
+    Trainer(cfg).run()
+    sidecar = os.path.join(src, "config.json")
+    saved = json.load(open(sidecar))
+    saved["init_from"] = str(tmp_path / "long_gone")  # dir no longer exists
+    json.dump(saved, open(sidecar, "w"))
+    out = str(tmp_path / "recal")
+    cli_main(["recalibrate", "--checkpoint-dir", src, "--out-dir", out,
+              "--batches", "1"])
+    restored = Trainer(get_config(
+        "smoke16", data_workers=1, eval_batches=1, checkpoint_dir=out,
+        global_batch=8,
+    ))
+    assert restored.resume_if_available() == 2
+
+
+def test_seg_ood_rotation_delta_vs_scale_control():
+    from featurenet_tpu.ood import (
+        ROTATION_PRESCALE,
+        _annotate_delta,
+        _annotate_rotation_control,
+    )
+
+    rows = [
+        {"family": "clean", "level": None, "mean_iou": 0.9},
+        {"family": "rotation", "level": 15.0, "mean_iou": 0.5},
+        {"family": "rotation", "level": "so3", "mean_iou": 0.4},
+        {"family": "scale", "level": ROTATION_PRESCALE, "mean_iou": 0.8},
+    ]
+    out = _annotate_rotation_control(
+        _annotate_delta(rows, "mean_iou"), "mean_iou"
+    )
+    rot15 = next(r for r in out if r["level"] == 15.0)
+    # vs clean the row mixes scale+rotation cost; vs the 0.7 pre-scale
+    # control it isolates rotation.
+    assert rot15["delta_vs_clean"] == pytest.approx(-0.4)
+    assert rot15["delta_vs_scale_control"] == pytest.approx(-0.3)
+    assert next(
+        r for r in out if r["family"] == "scale"
+    ).get("delta_vs_scale_control") is None
+    # Without the control row the annotation degrades gracefully.
+    no_ctrl = _annotate_rotation_control(
+        [{"family": "rotation", "level": 5.0, "mean_iou": 0.5}], "mean_iou"
+    )
+    assert "delta_vs_scale_control" not in no_ctrl[0]

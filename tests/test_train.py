@@ -376,7 +376,26 @@ def test_segmentation_loss_variants():
         segmentation_loss(perfect, seg, variant="nope")
 
 
-def test_trainer_planned_restart_segments(tmp_path):
+@pytest.fixture
+def no_persistent_compile_cache():
+    """Disable the persistent compilation cache for tests that build a
+    SECOND Trainer over identical computations in one process: the rebuilt
+    jits then execute executables DESERIALIZED from the cache, and the
+    AOT loader's machine-feature mismatch (documented in conftest.py as
+    log noise) can escalate to a fatal process abort in this sandbox.
+    The enable flag is only consulted when the cache object initializes,
+    so it must be paired with reset_cache() to take effect mid-process."""
+    from jax._src import compilation_cache as cc
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    cc.reset_cache()
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+    cc.reset_cache()
+
+
+def test_trainer_planned_restart_segments(tmp_path,
+                                          no_persistent_compile_cache):
     """restart_every_steps: the run stops at the segment boundary with a
     checkpoint exactly there and SystemExit(RESTART_EXIT_CODE); resuming
     continues to completion."""
