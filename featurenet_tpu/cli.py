@@ -254,6 +254,9 @@ def main(argv=None) -> None:
     sub.add_parser("bench")
     p_exp = sub.add_parser("export-data",
                            help="materialize the synthetic set as an npz cache")
+    p_exp.add_argument("--run-dir", dest="run_dir",
+                       help="observability directory: record per-class "
+                            "export spans (see `cli report`)")
     p_exp.add_argument("--out", required=True)
     p_exp.add_argument("--per-class", type=int, default=1000)
     p_exp.add_argument("--resolution", type=int, default=64)
@@ -355,19 +358,41 @@ def main(argv=None) -> None:
     p_bld.add_argument("--workers", type=int, default=None,
                        help="process-pool width for per-file voxelization "
                             "(default: cpu count; 1 = serial)")
+    p_bld.add_argument("--run-dir", dest="run_dir",
+                       help="observability directory: record per-class "
+                            "ingest spans (see `cli report`)")
     p_rep = sub.add_parser("report", allow_abbrev=False,
                            help="analyze a run directory's observability "
                                 "log (featurenet_tpu.obs): step-time "
-                                "breakdown, input-pipeline health, "
-                                "restart/stall timeline, serving latency")
+                                "breakdown, per-host merge + skew, "
+                                "input-pipeline health, restart/stall "
+                                "timeline, serving latency")
     p_rep.add_argument("run_dir", help="directory a run wrote via --run-dir")
     p_rep.add_argument("--json", action="store_true", dest="as_json",
                        help="emit the raw report dict as JSON instead of "
                             "the human-readable rendering")
     p_rep.add_argument("--trace", default=None,
                        help="also export the timing spans as a Chrome "
-                            "trace.json to this path (chrome://tracing, "
-                            "ui.perfetto.dev)")
+                            "trace.json to this path (one track per host; "
+                            "chrome://tracing, ui.perfetto.dev)")
+    p_rep.add_argument("--follow", action="store_true",
+                       help="live tail: re-read the event stream(s) "
+                            "incrementally and re-render the report every "
+                            "few seconds while the run is hot; exits on "
+                            "Ctrl-C or when the run ends")
+    p_rep.add_argument("--interval", type=float, default=3.0,
+                       help="--follow re-render period in seconds "
+                            "(default 3)")
+    p_rep.add_argument("--gate", default=None, metavar="BASELINE_JSON",
+                       help="evaluate regression gates against this pinned "
+                            "baseline (obs.gates); exits non-zero on any "
+                            "regression — data-wait fraction, p99 serving "
+                            "latency, step time, restart count, each with "
+                            "a tolerance")
+    p_rep.add_argument("--validate", action="store_true",
+                       help="event-schema lint: unknown event kinds, "
+                            "missing required fields, non-monotonic span "
+                            "nesting; exits non-zero on findings")
     p_inf = sub.add_parser("infer", allow_abbrev=False,
                            help="classify or segment STL files with a "
                                 "trained checkpoint")
@@ -400,20 +425,55 @@ def main(argv=None) -> None:
         # must work where the backend that produced the run is long gone.
         import os
 
-        from featurenet_tpu.obs.events import EVENTS_FILENAME
         from featurenet_tpu.obs.report import (
             build_report,
+            discover_event_files,
+            follow_report,
             format_report,
             load_events,
             load_manifest,
+            validate_events,
         )
 
-        if not os.path.exists(os.path.join(args.run_dir, EVENTS_FILENAME)):
+        files = discover_event_files(args.run_dir)
+        if not files:
+            # Say what IS here, not just what isn't: an empty dir, a
+            # per-host-only layout typo, or a wrong path each read
+            # differently to the operator.
+            if not os.path.isdir(args.run_dir):
+                raise SystemExit(
+                    f"report: {args.run_dir!r} is not a directory — was "
+                    "the run started with --run-dir pointing here?"
+                )
+            names = sorted(os.listdir(args.run_dir))
             raise SystemExit(
-                f"report: no {EVENTS_FILENAME} in {args.run_dir!r} — was "
-                "the run started with --run-dir pointing here?"
+                "report: no event stream (events.jsonl or "
+                f"events.<i>.jsonl) in {args.run_dir!r} — "
+                + (f"found: {', '.join(names)}" if names
+                   else "the directory is empty")
+                + "; was the run started with --run-dir pointing here?"
             )
+        if args.follow:
+            try:
+                follow_report(args.run_dir, interval=args.interval)
+            except KeyboardInterrupt:
+                print()  # clean ^C: no traceback over the live view
+            return
         events, bad = load_events(args.run_dir)
+        if args.validate:
+            findings = validate_events(events, bad_lines=bad)
+            for f in findings:
+                print(json.dumps(f, default=str))
+            if findings:
+                raise SystemExit(
+                    f"validate: {len(findings)} finding(s) across "
+                    f"{len(events)} event(s) in {len(files)} stream(s)"
+                )
+            print(json.dumps({
+                "validate": "ok", "events": len(events),
+                "streams": len(files),
+            }))
+            return
         rep = build_report(events, load_manifest(args.run_dir),
                            bad_lines=bad)
         if args.as_json:
@@ -426,6 +486,20 @@ def main(argv=None) -> None:
             with open(args.trace, "w") as fh:
                 json.dump(chrome_trace(events), fh)
             print(json.dumps({"trace": args.trace}))
+        if args.gate:
+            from featurenet_tpu.obs.gates import (
+                evaluate_gates,
+                format_gates,
+                load_baseline,
+                report_gate_values,
+            )
+
+            result = evaluate_gates(
+                report_gate_values(rep), load_baseline(args.gate)
+            )
+            print(format_gates(result, args.gate))
+            if not result["ok"]:
+                raise SystemExit(2)
         return
 
     if (
@@ -499,6 +573,11 @@ def main(argv=None) -> None:
     if args.cmd == "export-data":
         from featurenet_tpu.data.offline import export_synthetic_cache
 
+        if args.run_dir:
+            from featurenet_tpu import obs
+
+            obs.init_run(args.run_dir, extra={"cmd": "export-data"},
+                         process_index=0)
         pr = args.param_range
         if pr and "," in pr:
             pr = tuple(float(v) for v in pr.split(","))
@@ -659,6 +738,11 @@ def main(argv=None) -> None:
     if args.cmd == "build-cache":
         import os
 
+        if args.run_dir:
+            from featurenet_tpu import obs
+
+            obs.init_run(args.run_dir, extra={"cmd": "build-cache"},
+                         process_index=0)
         # A segmentation tree (index kind "segment_stl") takes the sidecar-
         # aware ingest; a classification class-dir tree takes build_cache.
         tree = {}

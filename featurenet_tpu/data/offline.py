@@ -36,6 +36,7 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
+from featurenet_tpu import obs
 from featurenet_tpu.data.stl import load_stl
 from featurenet_tpu.data.synthetic import (
     CLASS_NAMES,
@@ -111,8 +112,6 @@ def build_cache(
             label_ids[cls] = next_id
             next_id += 1
     if next_id > len(CLASS_NAMES):
-        from featurenet_tpu import obs
-
         unknown = [c for c in classes if c not in known]
         obs.warn(
             "build_cache_warning",
@@ -147,28 +146,33 @@ def build_cache(
             files = sorted(
                 f for f in os.listdir(cdir) if f.lower().endswith(".stl")
             )
-            packed = np.zeros(
-                (len(files), resolution, resolution, resolution // 8),
-                dtype=np.uint8,
-            )
-            work = [
-                (os.path.join(cdir, f), resolution, backend, True)
-                for f in files
-            ]
-            if pool is not None:
-                rows = pool.imap(
-                    _voxelize_stl_packed, work,
-                    chunksize=max(1, len(work) // (workers * 4) or 1),
+            # One span per class shard (the unit of visible progress —
+            # per-file timing lives in pool workers, where no sink is
+            # installed and the hook is a no-op).
+            with obs.span("build_cache_class", cls=cls, files=len(files),
+                          workers=workers):
+                packed = np.zeros(
+                    (len(files), resolution, resolution, resolution // 8),
+                    dtype=np.uint8,
                 )
-            else:
-                rows = map(_voxelize_stl_packed, work)
-            for i, row in enumerate(rows):
-                packed[i] = row
-            np.save(os.path.join(out_root, f"{cls}.npy"), packed)
-            with open(
-                os.path.join(out_root, f"{cls}.files.json"), "w"
-            ) as fh:
-                json.dump(files, fh)
+                work = [
+                    (os.path.join(cdir, f), resolution, backend, True)
+                    for f in files
+                ]
+                if pool is not None:
+                    rows = pool.imap(
+                        _voxelize_stl_packed, work,
+                        chunksize=max(1, len(work) // (workers * 4) or 1),
+                    )
+                else:
+                    rows = map(_voxelize_stl_packed, work)
+                for i, row in enumerate(rows):
+                    packed[i] = row
+                np.save(os.path.join(out_root, f"{cls}.npy"), packed)
+                with open(
+                    os.path.join(out_root, f"{cls}.files.json"), "w"
+                ) as fh:
+                    json.dump(files, fh)
             index["classes"].append(cls)
             index["counts"][cls] = len(files)
     except BaseException:
@@ -253,29 +257,31 @@ def export_synthetic_cache(
             (per_class, resolution, resolution, resolution // 8),
             dtype=np.uint8,
         )
-        for i in range(per_class):
-            part, _, _ = generate_sample(
-                rng, resolution, label=cls_id, orient=orient,
-                param_range=param_range,
-            )
-            if use_mesh:
-                from featurenet_tpu.data.voxel_to_mesh import (
-                    random_rotation_matrix,
-                    rotate_mesh,
-                    voxels_to_mesh,
+        with obs.span("export_class", cls=cls, n=per_class,
+                      mesh_pose=mesh_pose):
+            for i in range(per_class):
+                part, _, _ = generate_sample(
+                    rng, resolution, label=cls_id, orient=orient,
+                    param_range=param_range,
                 )
-                from featurenet_tpu.data.voxelize import voxelize
+                if use_mesh:
+                    from featurenet_tpu.data.voxel_to_mesh import (
+                        random_rotation_matrix,
+                        rotate_mesh,
+                        voxels_to_mesh,
+                    )
+                    from featurenet_tpu.data.voxelize import voxelize
 
-                tris = voxels_to_mesh(part.astype(bool))
-                if mesh_pose == "so3":
-                    tris = rotate_mesh(tris, random_rotation_matrix(rng))
-                m = (
-                    0.05 if margin_jitter is None
-                    else float(rng.uniform(*margin_jitter))
-                )
-                part = voxelize(tris, resolution, fill=True, margin=m)
-            packed[i] = pack_voxels(part)
-        np.save(os.path.join(out_root, f"{cls}.npy"), packed)
+                    tris = voxels_to_mesh(part.astype(bool))
+                    if mesh_pose == "so3":
+                        tris = rotate_mesh(tris, random_rotation_matrix(rng))
+                    m = (
+                        0.05 if margin_jitter is None
+                        else float(rng.uniform(*margin_jitter))
+                    )
+                    part = voxelize(tris, resolution, fill=True, margin=m)
+                packed[i] = pack_voxels(part)
+            np.save(os.path.join(out_root, f"{cls}.npy"), packed)
         with open(os.path.join(out_root, f"{cls}.files.json"), "w") as fh:
             json.dump([f"synthetic_{i:05d}" for i in range(per_class)], fh)
         index["classes"].append(cls)
@@ -355,19 +361,20 @@ def export_seg_cache(
     while done < num_parts:
         n = min(shard_size, num_parts - done)
         rng = np.random.default_rng(np.random.SeedSequence([seed, shard_id]))
-        voxels = np.zeros(
-            (n, resolution, resolution, resolution // 8), np.uint8
-        )
-        seg = np.zeros((n, resolution, resolution, resolution), np.int8)
-        for i in range(n):
-            part, s = _generate_seg_sample(
-                rng, resolution, num_features, label_order
-            )
-            voxels[i] = pack_voxels(part)
-            seg[i] = s.astype(np.int8)
         stem = f"seg_{shard_id:04d}"
-        np.save(os.path.join(out_root, f"{stem}.voxels.npy"), voxels)
-        np.save(os.path.join(out_root, f"{stem}.seg.npy"), seg)
+        with obs.span("export_seg_shard", shard=stem, n=n):
+            voxels = np.zeros(
+                (n, resolution, resolution, resolution // 8), np.uint8
+            )
+            seg = np.zeros((n, resolution, resolution, resolution), np.int8)
+            for i in range(n):
+                part, s = _generate_seg_sample(
+                    rng, resolution, num_features, label_order
+                )
+                voxels[i] = pack_voxels(part)
+                seg[i] = s.astype(np.int8)
+            np.save(os.path.join(out_root, f"{stem}.voxels.npy"), voxels)
+            np.save(os.path.join(out_root, f"{stem}.seg.npy"), seg)
         index["shards"].append({"stem": stem, "count": n})
         done += n
         shard_id += 1
@@ -462,35 +469,37 @@ def build_seg_cache(
         def flush():
             nonlocal shard_id
             stem = f"seg_{shard_id:04d}"
-            np.save(os.path.join(out_root, f"{stem}.voxels.npy"),
-                    np.stack(vox_buf))
-            np.save(os.path.join(out_root, f"{stem}.seg.npy"),
-                    np.stack(seg_buf))
+            with obs.span("seg_cache_flush", shard=stem, n=len(vox_buf)):
+                np.save(os.path.join(out_root, f"{stem}.voxels.npy"),
+                        np.stack(vox_buf))
+                np.save(os.path.join(out_root, f"{stem}.seg.npy"),
+                        np.stack(seg_buf))
             index["shards"].append({"stem": stem, "count": len(vox_buf)})
             vox_buf.clear()
             seg_buf.clear()
             shard_id += 1
 
-        for stem, packed in zip(stems, rows):
-            seg = np.load(os.path.join(pdir, stem + ".seg.npy"))
-            if seg.shape != (resolution,) * 3:
-                raise ValueError(
-                    f"{stem}: sidecar shape {seg.shape} != grid "
-                    f"{(resolution,) * 3}"
-                )
-            part = np.unpackbits(packed, axis=-1).astype(bool)
-            if (part & (seg > 0)).any():
-                raise ValueError(
-                    f"{stem}: labeled voxels occupied in the voxelized "
-                    "part — mesh and sidecar are misaligned (was the tree "
-                    "exported aligned_unit_cube?)"
-                )
-            vox_buf.append(packed)
-            seg_buf.append(seg.astype(np.int8))
-            if len(vox_buf) >= shard_size:
+        with obs.span("build_seg_cache", parts=len(stems), workers=workers):
+            for stem, packed in zip(stems, rows):
+                seg = np.load(os.path.join(pdir, stem + ".seg.npy"))
+                if seg.shape != (resolution,) * 3:
+                    raise ValueError(
+                        f"{stem}: sidecar shape {seg.shape} != grid "
+                        f"{(resolution,) * 3}"
+                    )
+                part = np.unpackbits(packed, axis=-1).astype(bool)
+                if (part & (seg > 0)).any():
+                    raise ValueError(
+                        f"{stem}: labeled voxels occupied in the voxelized "
+                        "part — mesh and sidecar are misaligned (was the "
+                        "tree exported aligned_unit_cube?)"
+                    )
+                vox_buf.append(packed)
+                seg_buf.append(seg.astype(np.int8))
+                if len(vox_buf) >= shard_size:
+                    flush()
+            if vox_buf:
                 flush()
-        if vox_buf:
-            flush()
     except BaseException:
         if pool is not None:
             pool.terminate()

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from featurenet_tpu import obs
+
 
 def normalize_mesh(triangles: np.ndarray, margin: float = 0.05) -> np.ndarray:
     """Center + uniformly scale triangles into [margin, 1-margin]³.
@@ -180,27 +182,34 @@ def voxelize(
         rasterize + exterior flood fill — conservative, tolerates small holes).
     """
     tris = np.asarray(triangles, dtype=np.float32)
-    if normalize:
-        tris = normalize_mesh(tris, margin=margin)
-    # The native path implements the parity fill and the exact shell; a
-    # "flood" fill request (hole-tolerant meshes) must stay on the numpy
-    # implementation rather than silently getting parity semantics.
-    native_ok = (not fill) or fill_method == "parity"
-    if backend == "native" and not native_ok:
-        raise ValueError(
-            "backend='native' has no flood fill; use fill_method='parity' "
-            "or backend='numpy'/'auto'"
-        )
-    if backend != "numpy" and native_ok:
-        try:
-            from featurenet_tpu.native import voxelize_native
+    # Batch-preprocessing span (no-op without an active run): export /
+    # build-cache pipelines run this per mesh, and the per-mesh wall is
+    # what sets ingest throughput (BASELINE.md's meshes/s line). Pool
+    # workers carry no sink, so the parallel path stays dark and free.
+    with obs.span("voxelize", tris=int(tris.shape[0]),
+                  resolution=resolution, fill=bool(fill)):
+        if normalize:
+            tris = normalize_mesh(tris, margin=margin)
+        # The native path implements the parity fill and the exact shell;
+        # a "flood" fill request (hole-tolerant meshes) must stay on the
+        # numpy implementation rather than silently getting parity
+        # semantics.
+        native_ok = (not fill) or fill_method == "parity"
+        if backend == "native" and not native_ok:
+            raise ValueError(
+                "backend='native' has no flood fill; use "
+                "fill_method='parity' or backend='numpy'/'auto'"
+            )
+        if backend != "numpy" and native_ok:
+            try:
+                from featurenet_tpu.native import voxelize_native
 
-            return voxelize_native(tris, resolution, fill)
-        except Exception:
-            if backend == "native":
-                raise
-    if not fill:
-        return _rasterize_surface(tris, resolution)
-    if fill_method == "flood":
-        return _fill_interior(_rasterize_surface(tris, resolution))
-    return _voxelize_parity(tris, resolution)
+                return voxelize_native(tris, resolution, fill)
+            except Exception:
+                if backend == "native":
+                    raise
+        if not fill:
+            return _rasterize_surface(tris, resolution)
+        if fill_method == "flood":
+            return _fill_interior(_rasterize_surface(tris, resolution))
+        return _voxelize_parity(tris, resolution)

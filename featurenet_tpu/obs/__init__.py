@@ -11,16 +11,26 @@ Setting ``Config.run_dir`` (CLI ``--run-dir``) makes every layer write into
 one run directory:
 
 - ``run.json``    — manifest: config, device topology, process index,
-                    start time (``events.init_run``).
-- ``events.jsonl``— append-only, thread-safe, process-shared event log:
-                    timing spans, gauges, metrics, warnings, heartbeats,
+                    start time (``events.init_run``; host 0 only).
+- ``events.jsonl``— host 0's append-only, thread-safe event log: timing
+                    spans, gauges, metrics, warnings, heartbeats,
                     supervisor restarts (``events.EventSink``).
+- ``events.<i>.jsonl`` — every other host's stream (multi-process runs;
+                    ``events.events_filename``). One file per writer, so
+                    nothing cross-host ever interleaves; the report layer
+                    merges them by timestamp and tags each record with
+                    its ``process_index``.
 
-Post-hoc, ``python -m featurenet_tpu.cli report <run_dir>`` folds the event
-log into a step-time breakdown (data-wait vs device vs eval vs checkpoint),
-prefetch-queue-depth percentiles, heartbeat-age max, a restart/stall
-timeline, and a serving-latency histogram (``report.py``); ``--trace``
-exports the spans as a Chrome ``trace.json`` (``spans.chrome_trace``).
+Post-hoc, ``python -m featurenet_tpu.cli report <run_dir>`` folds the
+merged log into a step-time breakdown (data-wait vs device vs eval vs
+checkpoint), prefetch-queue-depth percentiles, heartbeat-age max, a
+restart/stall timeline, a serving-latency histogram, and — for multi-host
+runs — a per-host breakdown with cross-host skew stats (``report.py``);
+``--follow`` live-tails the same streams incrementally while the run is
+hot; ``--trace`` exports the spans as a Chrome ``trace.json`` with one
+track per host (``spans.chrome_trace``); ``--validate`` lints the event
+schema; ``--gate baseline.json`` evaluates regression gates (``gates.py``)
+and exits non-zero on a regression.
 
 With no run_dir configured every hook is a no-op behind a single ``None``
 check — no file I/O, no timestamps, no measurable train-step overhead.
@@ -32,6 +42,7 @@ from featurenet_tpu.obs.events import (
     active,
     close_run,
     emit,
+    events_filename,
     gauge,
     init_run,
     warn,
@@ -44,6 +55,7 @@ __all__ = [
     "chrome_trace",
     "close_run",
     "emit",
+    "events_filename",
     "gauge",
     "init_run",
     "span",

@@ -3,17 +3,28 @@
 Event wire format: one JSON object per line in ``<run_dir>/events.jsonl``,
 every line carrying ``t`` (epoch seconds) and ``ev`` (the event type —
 ``span`` / ``gauge`` / ``metrics`` / ``warning`` / ``heartbeat`` /
-``supervisor`` / ``loop_start`` / ``loop_end`` / ``run_start``). The field
-is ``ev``, not ``kind``, so ``MetricLogger`` records — which already carry
-a ``kind`` of their own — route through unmodified.
+``supervisor`` / ``loop_start`` / ``loop_end`` / ``run_start`` /
+``run_end``). The field is ``ev``, not ``kind``, so ``MetricLogger``
+records — which already carry a ``kind`` of their own — route through
+unmodified.
+
+Multi-host layout: process 0 writes ``events.jsonl`` (the original
+single-file name, so every pre-existing log keeps reading) and is the sole
+owner of ``run.json``; every other process writes its own
+``events.<process_index>.jsonl`` (``events_filename``). One file per
+writer-host means no cross-host interleaving at all; the report layer
+(``obs.report.load_events``) discovers every stream, tags each record
+with its ``process_index``, and merges by timestamp.
 
 Concurrency: one lock per sink serializes threads; the file is opened
-``O_APPEND`` and each event is a single short ``write()``, so independent
-*processes* (the supervisor and its supervised child, or a restarted child
-appending to the same run) interleave whole lines, never fragments. The
-manifest (``run.json``) is written once per run directory — a respawned
-child finds it present and only appends a ``run_start`` event, keeping the
-original start time while making every restart visible in the timeline.
+``O_APPEND`` and each event is a single ``os.write`` of one complete line,
+so independent *processes* sharing a file (the supervisor and its
+supervised child, or a restarted child appending to the same run — both
+host-0 residents) interleave whole lines, never fragments, even through a
+shared filesystem client that honors O_APPEND. The manifest (``run.json``)
+is written once per run directory — a respawned child finds it present and
+only appends a ``run_start`` event, keeping the original start time while
+making every restart visible in the timeline.
 
 The module-level sink is what the instrumentation hooks (``emit`` /
 ``gauge`` / ``spans.span``) consult; when none is installed every hook
@@ -42,6 +53,15 @@ from typing import Any, Optional
 
 MANIFEST_FILENAME = "run.json"
 EVENTS_FILENAME = "events.jsonl"
+
+
+def events_filename(process_index: Optional[int] = 0) -> str:
+    """Per-host event stream name. Host 0 keeps the legacy single-file
+    name (old run dirs and old readers stay valid); host i>0 gets
+    ``events.<i>.jsonl``."""
+    if not process_index:
+        return EVENTS_FILENAME
+    return f"events.{int(process_index)}.jsonl"
 
 
 def _device_topology() -> dict:
@@ -107,30 +127,46 @@ class EventSink:
         self.path = os.path.join(self.run_dir, filename)
         self._lock = threading.Lock()
         self._pid = os.getpid()
-        self._f = open(self.path, "a", encoding="utf-8")
+        # Raw fd, O_APPEND: every emit below is exactly one os.write of one
+        # complete line. POSIX append semantics make each such write land
+        # at the (atomically advanced) end of file, so concurrent writers
+        # with independent fds — the supervisor and its child, a restarted
+        # child, obs.warn from two processes — can interleave lines but
+        # never shear one. A buffered file object would re-split the bytes
+        # at its own buffer boundary and void that guarantee.
+        self._fd: Optional[int] = os.open(
+            self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
+        )
 
     def emit(self, ev: str, **fields) -> None:
         """Write one event line. A ``t`` in ``fields`` overrides the
         auto-stamp (spans pass their start time so trace viewers see the
         interval where it began, not where it ended). Every line carries
-        the emitting pid: several processes share one log (supervisor +
-        child, restarted children), and the Chrome trace export groups
-        spans by it."""
+        the emitting pid: several processes may share one stream
+        (supervisor + child, restarted children), and the Chrome trace
+        export groups spans by it."""
         record = {"t": fields.pop("t", None) or time.time(), "ev": ev,
                   "pid": self._pid}
         record.update(fields)
-        line = json.dumps(record, default=str) + "\n"
+        data = (json.dumps(record, default=str) + "\n").encode("utf-8")
         with self._lock:
-            f = self._f
-            if f is None or f.closed:
+            if self._fd is None:
                 return
-            f.write(line)  # one write per line: process-atomic under append
-            f.flush()      # a crashed run's log must be complete to the crash
+            # Single unbuffered write per line (see __init__); no flush
+            # needed, so a crashed run's log is complete to the crash.
+            # Regular-file appends complete in one write() in practice; if
+            # the kernel ever returns short (ENOSPC boundary, quota), the
+            # atomicity of THIS line is already lost, so finishing the
+            # tail beats silently gluing it onto the next record.
+            view = memoryview(data)
+            while view:
+                view = view[os.write(self._fd, view):]
 
     def close(self) -> None:
         with self._lock:
-            if self._f is not None and not self._f.closed:
-                self._f.close()
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
 
 
 # --- module-level (process-wide) sink ----------------------------------------
@@ -140,7 +176,8 @@ _install_lock = threading.Lock()
 
 
 def init_run(run_dir: str, config: Optional[dict] = None,
-             extra: Optional[dict] = None) -> EventSink:
+             extra: Optional[dict] = None,
+             process_index: Optional[int] = None) -> EventSink:
     """Install the process-wide sink for ``run_dir`` and ensure ``run.json``.
 
     Idempotent per directory: re-initializing the same run_dir (a second
@@ -149,26 +186,34 @@ def init_run(run_dir: str, config: Optional[dict] = None,
     the new one. The manifest is written only if absent so restarts keep
     the run's original start time; every call appends a ``run_start``
     event, which is how the report reconstructs the restart timeline.
+
+    ``process_index``: which per-host stream this process owns
+    (``events_filename``). None = ask the JAX topology (0 when no backend
+    is reachable, so single-process callers never pay for the question).
+    Host 0 is the sole owner of ``run.json`` — on a shared filesystem N
+    hosts racing one manifest write would be the only cross-host file
+    race in the layer, so it is simply not run anywhere else.
     """
     global _sink
+    if process_index is None:
+        process_index = _device_topology().get("process_index", 0) or 0
     with _install_lock:
         target = os.path.abspath(run_dir)
-        if _sink is None or _sink.run_dir != target:
+        filename = events_filename(process_index)
+        path = os.path.join(target, filename)
+        if _sink is None or _sink.path != path:
             if _sink is not None:
                 _sink.close()
-            _sink = EventSink(target)
-        manifest_path = os.path.join(target, MANIFEST_FILENAME)
-        if not os.path.exists(manifest_path):
-            tmp = manifest_path + ".tmp"  # atomic: never half a manifest
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(run_manifest(run_dir, config, extra), fh,
-                          indent=1, default=str)
-            os.replace(tmp, manifest_path)
-        topo = _device_topology()
-        _sink.emit(
-            "run_start",
-            process_index=topo.get("process_index", 0),
-        )
+            _sink = EventSink(target, filename=filename)
+        if process_index == 0:
+            manifest_path = os.path.join(target, MANIFEST_FILENAME)
+            if not os.path.exists(manifest_path):
+                tmp = manifest_path + ".tmp"  # atomic: never half a manifest
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(run_manifest(run_dir, config, extra), fh,
+                              indent=1, default=str)
+                os.replace(tmp, manifest_path)
+        _sink.emit("run_start", process_index=process_index)
         return _sink
 
 

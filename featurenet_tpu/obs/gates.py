@@ -1,0 +1,210 @@
+"""Report-driven regression gates: a run (or bench round) judges itself.
+
+The perf trajectory used to live only in human eyes reading BENCH_*.json
+diffs; a regression surfaced a round late, if at all. A gate pins a
+baseline — data-wait fraction, p99 serving latency, step time, restart
+count, bench throughput — with a tolerance, and a completed run's report
+is evaluated against it mechanically:
+
+- ``cli report <run_dir> --gate baseline.json`` exits non-zero on any
+  regression (CI-able: train, then gate the run's own telemetry).
+- ``bench.py`` emits a pin-ready ``gate_summary`` each round and checks
+  itself against the previously pinned round (``BENCH_baseline.json``).
+
+Baseline JSON shape (``{"gates": {...}}`` wrapper optional)::
+
+    {"gates": {
+        "data_wait_fraction": {"value": 0.25, "tolerance": 0.10},
+        "serving_p99_ms":     {"value": 12.0, "tolerance": 0.15},
+        "restarts":           {"value": 0, "tolerance_abs": 1},
+        "e2e_samples_per_sec": {"value": 9800, "direction": "min"}
+    }}
+
+``tolerance`` is relative (default 0.10), ``tolerance_abs`` absolute
+(default 0 — the only meaningful slack for a zero baseline like restart
+count); both may be given and add. ``direction`` says which way is a
+regression: ``"max"`` = higher is worse (latencies, fractions, counts),
+``"min"`` = lower is worse (throughputs). Unknown metrics default to
+``"max"`` — pessimism beats silently waving a regression through. A
+metric the baseline pins but the report lacks is a failure too
+("missing"): a gate that can't see its metric must not pass.
+
+Stdlib-only, like the rest of the report path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+DEFAULT_TOLERANCE = 0.10
+
+# Which way is worse, per known metric. Everything extracted from a run
+# report regresses upward; bench throughput/MFU regress downward.
+DIRECTIONS = {
+    "data_wait_fraction": "max",
+    "step_ms": "max",
+    "serving_p99_ms": "max",
+    "serving_mean_ms": "max",
+    "restarts": "max",
+    "stalls": "max",
+    "heartbeat_max_age_s": "max",
+    "bad_lines": "max",
+    # bench summary keys (see bench_gate_values)
+    "value": "min",
+    "serving_inferences_per_sec_per_chip": "min",
+    "mfu": "min",
+    "e2e_samples_per_sec": "min",
+    "e2e_pipelined_samples_per_sec": "min",
+    "e2e_hbm_samples_per_sec": "min",
+    "spread_pct": "max",
+    "serving_spread_pct": "max",
+}
+
+
+def report_gate_values(rep: dict) -> dict[str, float]:
+    """The gateable scalars of a run report (``obs.report.build_report``).
+    Only metrics the run actually recorded appear — a classify train run
+    with no serving spans simply has no ``serving_p99_ms`` to gate."""
+    vals: dict[str, float] = {}
+    bd = rep.get("breakdown")
+    if bd:
+        vals["data_wait_fraction"] = bd["data_wait"]["fraction"]
+    loop = rep.get("loop") or {}
+    if loop.get("step_ms") is not None:
+        vals["step_ms"] = loop["step_ms"]
+    sv = rep.get("serving_latency_ms")
+    if sv:
+        vals["serving_p99_ms"] = sv["p99"]
+        vals["serving_mean_ms"] = sv["mean"]
+    sup = rep.get("supervisor")
+    vals["restarts"] = float((sup or {}).get("restarts", 0))
+    vals["stalls"] = float((sup or {}).get("stalls", 0))
+    hb = rep.get("heartbeat")
+    if hb and hb.get("max_age_s") is not None:
+        vals["heartbeat_max_age_s"] = hb["max_age_s"]
+    vals["bad_lines"] = float(rep.get("bad_lines", 0))
+    return vals
+
+
+# Bench-summary keys worth pinning round over round (bench.py's output
+# dict). Spreads are deliberately absent: they bound measurement quality,
+# not performance, and gating them would fail honest noisy rounds.
+BENCH_GATE_KEYS = (
+    "value",
+    "serving_inferences_per_sec_per_chip",
+    "mfu",
+    "e2e_samples_per_sec",
+    "e2e_pipelined_samples_per_sec",
+    "e2e_hbm_samples_per_sec",
+)
+
+
+def bench_gate_values(summary: dict) -> dict[str, float]:
+    return {
+        k: float(summary[k]) for k in BENCH_GATE_KEYS
+        if isinstance(summary.get(k), (int, float))
+    }
+
+
+def make_baseline(values: dict[str, float],
+                  tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Pin-ready baseline from current values (what bench emits as
+    ``gate_summary`` and what an operator freezes after a good run)."""
+    return {
+        "gates": {
+            name: {
+                "value": v,
+                "tolerance": tolerance,
+                "direction": DIRECTIONS.get(name, "max"),
+            }
+            for name, v in sorted(values.items())
+        }
+    }
+
+
+def evaluate_gates(values: dict[str, float], baseline: dict) -> dict:
+    """Judge ``values`` against a baseline spec. Returns
+    ``{"ok": bool, "failed": [names], "gates": [per-gate records]}`` —
+    ``ok`` only when every pinned metric is present and within its limit.
+    """
+    spec = baseline.get("gates", baseline)
+    gates: list[dict] = []
+    failed: list[str] = []
+    for name in sorted(spec):
+        b = spec[name]
+        if not isinstance(b, dict):
+            b = {"value": b}
+        base = float(b["value"])
+        tol = float(b.get("tolerance", DEFAULT_TOLERANCE))
+        tol_abs = float(b.get("tolerance_abs", 0.0))
+        direction = b.get("direction") or DIRECTIONS.get(name, "max")
+        rec: dict = {
+            "metric": name,
+            "baseline": base,
+            "tolerance": tol,
+            "direction": direction,
+        }
+        if tol_abs:
+            rec["tolerance_abs"] = tol_abs
+        value = values.get(name)
+        if value is None:
+            rec.update(status="missing", value=None)
+            failed.append(name)
+            gates.append(rec)
+            continue
+        value = float(value)
+        if direction == "min":
+            limit = base * (1.0 - tol) - tol_abs
+            ok = value >= limit - 1e-12
+        else:
+            limit = base * (1.0 + tol) + tol_abs
+            ok = value <= limit + 1e-12
+        rec.update(
+            status="pass" if ok else "fail",
+            value=value,
+            limit=round(limit, 6),
+        )
+        if not ok:
+            failed.append(name)
+        gates.append(rec)
+    return {"ok": not failed, "failed": failed, "gates": gates}
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        baseline = json.load(fh)
+    spec = baseline.get("gates", baseline)
+    if not isinstance(spec, dict) or not spec:
+        raise ValueError(
+            f"baseline {path!r} pins no gates — expected "
+            '{"gates": {"<metric>": {"value": ...}}} or a flat '
+            "metric→value object"
+        )
+    return baseline
+
+
+def format_gates(result: dict, baseline_path: Optional[str] = None) -> str:
+    lines = []
+    head = "gate: " + ("PASS" if result["ok"] else "FAIL")
+    if baseline_path:
+        head += f" (baseline {baseline_path})"
+    lines.append(head)
+    for g in result["gates"]:
+        arrow = "<=" if g["direction"] == "max" else ">="
+        if g["status"] == "missing":
+            lines.append(
+                f"  MISSING {g['metric']}: pinned at {g['baseline']} but "
+                "absent from this report"
+            )
+        else:
+            lines.append(
+                f"  {'ok' if g['status'] == 'pass' else 'FAIL':<4} "
+                f"{g['metric']:<36} {g['value']:>12.4g} {arrow} "
+                f"{g['limit']:<12.4g} (baseline {g['baseline']:g}, "
+                f"tol {g['tolerance'] * 100:g}%"
+                + (f" + {g['tolerance_abs']:g}" if g.get("tolerance_abs")
+                   else "")
+                + ")"
+            )
+    return "\n".join(lines)

@@ -1,4 +1,4 @@
-"""Post-hoc run analysis: fold ``events.jsonl`` into answers.
+"""Post-hoc run analysis: fold the run's event stream(s) into answers.
 
 ``build_report`` turns a run directory's event log into the questions an
 operator actually asks after a run (or a crash):
@@ -14,6 +14,16 @@ operator actually asks after a run (or a crash):
   supervisor's restart/stall timeline, warning counts, and every
   ``run_start`` (each process (re)spawn) in order.
 - **How fast is serving?** Per-batch ``infer_batch`` latency percentiles.
+- **Which host is the problem?** Multi-process runs write one stream per
+  host (``events.<i>.jsonl``); ``load_events`` discovers and merges them,
+  tagging every record with its ``process_index``, and the report grows a
+  per-host breakdown (data-wait fraction, heartbeat gaps, warnings) plus
+  cross-host skew stats — the slowest host's data-wait is where a lockstep
+  mesh actually spends its time.
+
+``EventTail`` + ``follow_report`` are the live view: re-read the same
+streams incrementally (seek to the last offset, parse only new complete
+lines) and re-render while the run is hot.
 
 Everything here is stdlib-only and never touches JAX — the report CLI
 must run on a machine (or in a moment) where the backend that produced
@@ -24,7 +34,9 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Optional
+import re
+import time
+from typing import Callable, Iterable, Optional
 
 from featurenet_tpu.obs.events import EVENTS_FILENAME, MANIFEST_FILENAME
 
@@ -33,28 +45,71 @@ from featurenet_tpu.obs.events import EVENTS_FILENAME, MANIFEST_FILENAME
 # where the host actually blocks on device execution.
 LOOP_CATEGORIES = ("data_wait", "dispatch", "readback", "eval", "checkpoint")
 
+_PER_HOST_RE = re.compile(r"events\.(\d+)\.jsonl\Z")
 
-def load_events(run_dir: str) -> tuple[list[dict], int]:
-    """All events, time-ordered, plus the count of unparseable lines (a
+
+def discover_event_files(run_dir: str) -> list[tuple[str, int]]:
+    """Every event stream in ``run_dir`` as ``(path, process_index)``,
+    index-ordered. Accepts the legacy single-file layout (``events.jsonl``
+    = host 0), the per-host layout (``events.<i>.jsonl``), and any mix —
+    including a dir where host 0's file is missing (e.g. only non-zero
+    hosts shared this filesystem)."""
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return []
+    found: list[tuple[str, int]] = []
+    for name in names:
+        if name == EVENTS_FILENAME:
+            found.append((os.path.join(run_dir, name), 0))
+        else:
+            m = _PER_HOST_RE.match(name)
+            if m:
+                found.append((os.path.join(run_dir, name), int(m.group(1))))
+    return sorted(found, key=lambda pi: pi[1])
+
+
+def _parse_lines(lines: Iterable[str], process_index: int,
+                 events: list[dict]) -> int:
+    """Parse JSONL lines into ``events`` (tagging each record with its
+    stream's ``process_index``); returns the unparseable-line count (a
     torn line from a killed process must not take the report down with
     it — it is exactly the crashed run we are here to inspect)."""
-    path = os.path.join(run_dir, EVENTS_FILENAME)
+    bad = 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            e = json.loads(line)
+        except ValueError:
+            bad += 1
+            continue
+        if isinstance(e, dict) and "t" in e and "ev" in e:
+            e.setdefault("process_index", process_index)
+            events.append(e)
+        else:
+            bad += 1
+    return bad
+
+
+def load_events(run_dir: str) -> tuple[list[dict], int]:
+    """All events from every discovered per-host stream, merged and
+    time-ordered, each tagged with the ``process_index`` of the stream it
+    came from; plus the count of unparseable lines across all streams.
+    Raises ``FileNotFoundError`` when the directory holds no event stream
+    at all (callers can render what *was* found)."""
+    files = discover_event_files(run_dir)
+    if not files:
+        raise FileNotFoundError(
+            f"no event stream ({EVENTS_FILENAME} or events.<i>.jsonl) "
+            f"in {run_dir!r}"
+        )
     events: list[dict] = []
     bad = 0
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                e = json.loads(line)
-            except ValueError:
-                bad += 1
-                continue
-            if isinstance(e, dict) and "t" in e and "ev" in e:
-                events.append(e)
-            else:
-                bad += 1
+    for path, idx in files:
+        with open(path, encoding="utf-8") as fh:
+            bad += _parse_lines(fh, idx, events)
     events.sort(key=lambda e: e["t"])
     return events, bad
 
@@ -110,22 +165,12 @@ def _loop_windows(events: list[dict]) -> list[tuple[dict, dict]]:
     return windows
 
 
-def build_report(events: list[dict], manifest: Optional[dict] = None,
-                 bad_lines: int = 0) -> dict:
-    rep: dict = {"n_events": len(events), "bad_lines": bad_lines}
-    if manifest:
-        cfg = manifest.get("config") or {}
-        rep["run"] = {
-            "run_dir": manifest.get("run_dir"),
-            "start_time": manifest.get("start_time"),
-            "config_name": cfg.get("name"),
-            "task": cfg.get("task"),
-            "process_index": (manifest.get("jax") or {}).get("process_index"),
-            "device_count": (manifest.get("jax") or {}).get("device_count"),
-        }
-    rep["process_starts"] = sum(1 for e in events if e["ev"] == "run_start")
-
-    # --- step-time breakdown over the loop window(s) ------------------------
+def _loop_stats(events: list[dict]) -> tuple[dict, Optional[dict],
+                                             Optional[float]]:
+    """One host's loop section: ``(loop, breakdown, attributed_fraction)``
+    — the latter two None when no loop wall was recorded. Shared by the
+    main report body and the per-host summaries so both attribute span
+    time the same way."""
     windows = _loop_windows(events)
     wall = sum(
         end.get("wall_s", end["t"] - start["t"]) for start, end in windows
@@ -142,7 +187,7 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
     for s in in_window:
         if s.get("name") in cat_s:
             cat_s[s["name"]] += s["dur_s"]
-    rep["loop"] = {
+    loop = {
         "windows": len(windows),
         "truncated_windows": sum(
             1 for _, end in windows if end.get("truncated")
@@ -151,23 +196,136 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
         "steps": steps,
         "step_ms": round(wall / steps * 1e3, 2) if steps else None,
     }
-    if wall > 0:
-        attributed = sum(cat_s.values())
-        breakdown = {
-            c: {"seconds": round(v, 4), "fraction": round(v / wall, 4)}
-            for c, v in cat_s.items()
-        }
-        other = max(wall - attributed, 0.0)
-        breakdown["other"] = {
-            "seconds": round(other, 4),
-            "fraction": round(other / wall, 4),
-        }
-        rep["breakdown"] = breakdown
-        rep["attributed_fraction"] = round(min(attributed / wall, 1.0), 4)
+    if wall <= 0:
+        return loop, None, None
+    attributed = sum(cat_s.values())
+    breakdown = {
+        c: {"seconds": round(v, 4), "fraction": round(v / wall, 4)}
+        for c, v in cat_s.items()
+    }
+    other = max(wall - attributed, 0.0)
+    breakdown["other"] = {
+        "seconds": round(other, 4),
+        "fraction": round(other / wall, 4),
+    }
+    return loop, breakdown, round(min(attributed / wall, 1.0), 4)
 
-    # --- input pipeline -----------------------------------------------------
+
+def _host_summary(events: list[dict]) -> dict:
+    """Per-host digest for the multi-host section: where did THIS host's
+    loop wall go, did its heartbeat gap (stall attribution — the host
+    whose beats stopped is the one that hung), what did it warn about."""
+    loop, breakdown, attributed = _loop_stats(events)
+    out: dict = {
+        "events": len(events),
+        "wall_s": loop["wall_s"],
+        "steps": loop["steps"],
+        "step_ms": loop["step_ms"],
+    }
+    if breakdown is not None:
+        out["fractions"] = {
+            name: row["fraction"] for name, row in breakdown.items()
+        }
+        out["attributed_fraction"] = attributed
+    starts = [e["t"] for e in events if e["ev"] == "loop_start"]
+    if starts:
+        out["t_first_loop_start"] = round(min(starts), 3)
+    beat_ts = sorted(e["t"] for e in events if e["ev"] == "heartbeat")
+    ages = [e.get("age_s") for e in events
+            if e["ev"] == "heartbeat" and e.get("age_s") is not None]
+    out["heartbeat"] = {
+        "beats": len(beat_ts),
+        "max_age_s": round(max(ages), 3) if ages else None,
+        # Largest observed silence between consecutive beats, extended to
+        # the host's last event: a host that stopped beating mid-run shows
+        # the gap even though no later beat ever stamped an age.
+        "max_gap_s": round(max(
+            [b - a for a, b in zip(beat_ts, beat_ts[1:])]
+            + ([events[-1]["t"] - beat_ts[-1]] if events else []),
+        ), 3) if beat_ts else None,
+    }
+    n_warn = sum(1 for e in events if e["ev"] == "warning")
+    if n_warn:
+        out["warnings"] = n_warn
+    return out
+
+
+def _host_skew(hosts: dict[int, dict]) -> dict:
+    """Cross-host skew: how far apart the hosts' loops started, how
+    unevenly the input pipeline starved them, and whether any host fell
+    out of step (lockstep dispatch means the global step time is the
+    slowest host's — a fat data-wait spread is free throughput)."""
+    skew: dict = {}
+    starts = [h["t_first_loop_start"] for h in hosts.values()
+              if h.get("t_first_loop_start") is not None]
+    if len(starts) >= 2:
+        skew["loop_start_skew_s"] = round(max(starts) - min(starts), 3)
+    walls = [h["wall_s"] for h in hosts.values() if h.get("wall_s")]
+    if len(walls) >= 2:
+        skew["wall_s_skew"] = round(max(walls) - min(walls), 4)
+    dw = [h["fractions"]["data_wait"] for h in hosts.values()
+          if h.get("fractions")]
+    if len(dw) >= 2:
+        skew["data_wait_fraction"] = {
+            "min": round(min(dw), 4),
+            "max": round(max(dw), 4),
+            "spread": round(max(dw) - min(dw), 4),
+        }
+    steps = {i: h["steps"] for i, h in hosts.items()}
+    if len(set(steps.values())) > 1:
+        # Hosts run the same global loop; a step mismatch means a stream
+        # is truncated (killed host) or a host diverged — surface it.
+        skew["step_mismatch"] = steps
+    return skew
+
+
+def build_report(events: list[dict], manifest: Optional[dict] = None,
+                 bad_lines: int = 0) -> dict:
+    by_host: dict[int, list[dict]] = {}
+    for e in events:
+        by_host.setdefault(int(e.get("process_index") or 0), []).append(e)
+    # Host 0's stream carries the canonical loop (plus the supervisor's
+    # events); a run dir holding only non-zero hosts' streams still
+    # reports, anchored on the lowest index present.
+    primary_idx = 0 if 0 in by_host or not by_host else min(by_host)
+    primary = by_host.get(primary_idx, [])
+
+    rep: dict = {"n_events": len(events), "bad_lines": bad_lines}
+    if manifest:
+        cfg = manifest.get("config") or {}
+        rep["run"] = {
+            "run_dir": manifest.get("run_dir"),
+            "start_time": manifest.get("start_time"),
+            "config_name": cfg.get("name"),
+            "task": cfg.get("task"),
+            "process_index": (manifest.get("jax") or {}).get("process_index"),
+            "device_count": (manifest.get("jax") or {}).get("device_count"),
+        }
+    # Primary host only: this field is the RESPAWN counter (PR 1's restart
+    # timeline), and every host's init_run emits one run_start — counting
+    # across hosts would read a clean 4-host run as three restarts.
+    rep["process_starts"] = sum(
+        1 for e in primary if e["ev"] == "run_start"
+    )
+
+    # --- step-time breakdown over the primary host's loop window(s) ---------
+    loop, breakdown, attributed = _loop_stats(primary)
+    rep["loop"] = loop
+    if breakdown is not None:
+        rep["breakdown"] = breakdown
+        rep["attributed_fraction"] = attributed
+    spans = [e for e in primary if e["ev"] == "span" and "dur_s" in e]
+
+    # --- per-host breakdown + cross-host skew (multi-process runs) ----------
+    if len(by_host) > 1:
+        rep["hosts"] = {
+            i: _host_summary(evts) for i, evts in sorted(by_host.items())
+        }
+        rep["host_skew"] = _host_skew(rep["hosts"])
+
+    # --- input pipeline (primary host) --------------------------------------
     depths = sorted(
-        e["value"] for e in events
+        e["value"] for e in primary
         if e["ev"] == "gauge" and e.get("name") == "prefetch_queue_depth"
     )
     if depths:
@@ -179,7 +337,7 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             "max": depths[-1],
         }
     gen = sorted(
-        e["value"] for e in events
+        e["value"] for e in primary
         if e["ev"] == "gauge" and e.get("name") == "producer_batch_s"
     )
     if gen:
@@ -191,7 +349,10 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
         }
 
     # --- liveness / supervision --------------------------------------------
-    beats = [e for e in events if e["ev"] == "heartbeat"]
+    # Heartbeats: primary host (per-host gaps live in rep["hosts"]); the
+    # supervisor timeline spans every stream — it writes into host 0's
+    # file, but synthetic/merged logs may carry it anywhere.
+    beats = [e for e in primary if e["ev"] == "heartbeat"]
     if beats:
         ages = [e.get("age_s") for e in beats if e.get("age_s") is not None]
         rep["heartbeat"] = {
@@ -231,13 +392,16 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
         }
 
     # --- warnings / metrics -------------------------------------------------
+    # Warnings aggregate across every host (a warning on host 3 must not
+    # be invisible in the headline); metrics records would be N-fold
+    # duplicates of the same global values, so the primary host speaks.
     warns = [e for e in events if e["ev"] == "warning"]
     if warns:
         by_name: dict[str, int] = {}
         for e in warns:
             by_name[e.get("name", "?")] = by_name.get(e.get("name", "?"), 0) + 1
         rep["warnings"] = by_name
-    metrics = [e for e in events if e["ev"] == "metrics"]
+    metrics = [e for e in primary if e["ev"] == "metrics"]
     if metrics:
         last: dict[str, dict] = {}
         for e in metrics:
@@ -297,6 +461,44 @@ def format_report(rep: dict) -> str:
             f"  attributed (non-other): "
             f"{rep['attributed_fraction'] * 100:.1f}%"
         )
+    hosts = rep.get("hosts")
+    if hosts:
+        lines.append(f"hosts: {len(hosts)} event stream(s)")
+        lines.append(
+            "  host   wall        steps  data_wait  beats  max_gap  warn"
+        )
+        for i in sorted(hosts):
+            h = hosts[i]
+            fr = h.get("fractions") or {}
+            dw = fr.get("data_wait")
+            hb = h.get("heartbeat") or {}
+            gap = hb.get("max_gap_s")
+            lines.append(
+                f"  {i:<5}  {_fmt_s(h['wall_s']):>9}  {h['steps']:>5}  "
+                + (f"{dw * 100:8.1f}%" if dw is not None else f"{'—':>9}")
+                + f"  {hb.get('beats', 0):>5}  "
+                + (f"{gap:>6.1f}s" if gap is not None else f"{'—':>7}")
+                + f"  {h.get('warnings', 0):>4}"
+            )
+        skew = rep.get("host_skew") or {}
+        parts = []
+        if "loop_start_skew_s" in skew:
+            parts.append(f"loop start {skew['loop_start_skew_s']}s")
+        if "wall_s_skew" in skew:
+            parts.append(f"wall {skew['wall_s_skew']}s")
+        dwf = skew.get("data_wait_fraction")
+        if dwf:
+            parts.append(
+                f"data_wait {dwf['min'] * 100:.1f}%–{dwf['max'] * 100:.1f}% "
+                f"(spread {dwf['spread'] * 100:.1f}pp)"
+            )
+        if parts:
+            lines.append("host skew: " + ", ".join(parts))
+        if skew.get("step_mismatch"):
+            lines.append(
+                "  STEP MISMATCH across hosts (truncated stream or "
+                f"diverged host): {skew['step_mismatch']}"
+            )
     q = rep.get("prefetch_queue_depth")
     if q:
         lines.append(
@@ -349,3 +551,214 @@ def format_report(rep: dict) -> str:
             }
             lines.append(f"  last {kind}: {json.dumps(keep)}")
     return "\n".join(lines)
+
+
+# --- live tail ---------------------------------------------------------------
+
+class EventTail:
+    """Incremental reader over a run directory's event stream(s).
+
+    Each ``poll()`` re-discovers the per-host files (a late host's stream
+    appears mid-run), seeks every known file to its last consumed offset,
+    and parses only the new COMPLETE lines — a partial trailing line (a
+    writer mid-``write`` on a non-POSIX filesystem, or a reader racing the
+    kernel) is left for the next poll rather than counted as corrupt.
+    Nothing is ever re-parsed: the PARSING cost of a poll is only the
+    bytes appended since the last one. (Each re-render still folds the
+    full accumulated history — build_report is O(events) — which is fine
+    for the runs this repo produces; a multi-day tail would want a
+    windowed report.)
+    """
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self.events: list[dict] = []
+        self.bad = 0
+        self._offsets: dict[str, int] = {}
+
+    def poll(self) -> list[dict]:
+        """Consume and return the newly appended events (also accumulated
+        into ``self.events``, unsorted — sort before reporting)."""
+        new: list[dict] = []
+        for path, idx in discover_event_files(self.run_dir):
+            offset = self._offsets.get(path, 0)
+            try:
+                size = os.path.getsize(path)
+                if size <= offset:
+                    continue
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    chunk = fh.read(size - offset)
+            except OSError:
+                continue  # rotated/removed underneath us: re-poll later
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue  # no complete line yet
+            self._offsets[path] = offset + last_nl + 1
+            lines = chunk[:last_nl].decode("utf-8", "replace").splitlines()
+            self.bad += _parse_lines(lines, idx, new)
+        self.events.extend(new)
+        return new
+
+
+def is_terminal_event(e: dict) -> bool:
+    """True when this event can mean the run is over: a ``run_end`` (one
+    host completed its full step budget) or the supervisor's final verdict
+    (``done`` / ``giving_up`` — restart budget exhausted). A supervisor
+    verdict ends the whole run; a ``run_end`` ends only its own host's
+    stream — ``follow_report`` waits for one per discovered stream, so a
+    fast host finishing first doesn't declare a still-running mesh done."""
+    return e.get("ev") == "run_end" or (
+        e.get("ev") == "supervisor"
+        and e.get("phase") in ("done", "giving_up")
+    )
+
+
+def follow_report(
+    run_dir: str,
+    interval: float = 3.0,
+    out: Callable[[str], None] = print,
+    clock: Callable[[float], None] = time.sleep,
+    max_polls: Optional[int] = None,
+    clear: bool = True,
+) -> None:
+    """Live tail: re-render the report every ``interval`` seconds while the
+    run is hot; return when a terminal event appears (``is_terminal_event``)
+    or after ``max_polls`` polls (tests). Ctrl-C is the caller's concern —
+    the CLI wraps this in a KeyboardInterrupt handler so ^C exits cleanly
+    rather than with a stack trace."""
+    tail = EventTail(run_dir)
+    manifest = None
+    polls = 0
+    ended_hosts: set[int] = set()
+    supervisor_verdict = False
+    while True:
+        new = tail.poll()
+        if manifest is None:
+            manifest = load_manifest(run_dir)
+        if new or polls == 0:
+            events = sorted(tail.events, key=lambda e: e["t"])
+            rep = build_report(events, manifest, bad_lines=tail.bad)
+            prefix = "\x1b[2J\x1b[H" if clear else ""
+            out(
+                prefix + format_report(rep)
+                + f"\n-- following {run_dir} ({len(events)} events, "
+                f"re-render every {interval:g}s; Ctrl-C to stop)"
+            )
+        for e in new:
+            if e.get("ev") == "run_end":
+                ended_hosts.add(int(e.get("process_index") or 0))
+            elif is_terminal_event(e):
+                supervisor_verdict = True
+        # The supervisor's verdict ends everything; run_end is per host —
+        # exit only once every discovered stream has produced one, so the
+        # slowest host's tail (and the final checkpoint it is writing)
+        # still renders.
+        streams = {idx for _, idx in discover_event_files(run_dir)}
+        if supervisor_verdict or (streams and ended_hosts >= streams):
+            out("-- run ended; follow exiting")
+            return
+        polls += 1
+        if max_polls is not None and polls >= max_polls:
+            return
+        clock(interval)
+
+
+# --- event-schema lint -------------------------------------------------------
+
+KNOWN_EVENT_KINDS = frozenset({
+    "run_start", "run_end", "span", "gauge", "metrics", "warning",
+    "heartbeat", "supervisor", "loop_start", "loop_end",
+})
+
+# Fields (beyond t/ev) a record must carry for the report to fold it.
+REQUIRED_EVENT_FIELDS = {
+    "span": ("name", "dur_s"),
+    "gauge": ("name", "value"),
+    "warning": ("name", "msg"),
+    "supervisor": ("phase",),
+    "loop_start": ("step",),
+    "loop_end": ("step",),
+    "metrics": ("kind",),
+}
+
+# Wall-clock start stamps vs perf_counter durations: a parent records its
+# start before the child does and emits after, so real nesting violates
+# containment only by clock jitter — allow a small slack.
+_NEST_EPS_S = 0.05
+
+
+def validate_events(events: list[dict], bad_lines: int = 0) -> list[dict]:
+    """Schema lint: unknown event kinds, missing required fields, negative
+    durations, and non-monotonic span nesting (a span naming a ``parent``
+    must fit inside some same-thread span of that name — a child interval
+    escaping its parent means a torn/reordered stream or a broken clock).
+    Returns finding dicts (``check`` / ``msg`` / optional ``event``);
+    empty = clean. Malformed telemetry should fail fast in CI, not
+    corrupt reports quietly."""
+    findings: list[dict] = []
+    if bad_lines:
+        findings.append({
+            "check": "parse",
+            "msg": f"{bad_lines} unparseable line(s) in the stream(s)",
+        })
+    spans_by_thread: dict[tuple, list[dict]] = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev not in KNOWN_EVENT_KINDS:
+            findings.append({
+                "check": "unknown_kind",
+                "msg": f"unknown event kind {ev!r}",
+                "event": e,
+            })
+            continue
+        missing = [
+            f for f in REQUIRED_EVENT_FIELDS.get(ev, ())
+            if f not in e
+        ]
+        if missing:
+            findings.append({
+                "check": "missing_fields",
+                "msg": f"{ev!r} event missing required field(s) "
+                       f"{missing}",
+                "event": e,
+            })
+            continue
+        if ev == "span":
+            if e["dur_s"] < 0:
+                findings.append({
+                    "check": "negative_duration",
+                    "msg": f"span {e.get('name')!r} has dur_s {e['dur_s']}",
+                    "event": e,
+                })
+                continue
+            key = (e.get("process_index", 0), e.get("pid"), e.get("thread"))
+            spans_by_thread.setdefault(key, []).append(e)
+    for group in spans_by_thread.values():
+        for s in group:
+            parent = s.get("parent")
+            if not parent:
+                continue
+            candidates = [q for q in group if q.get("name") == parent]
+            if not candidates:
+                findings.append({
+                    "check": "orphan_parent",
+                    "msg": f"span {s.get('name')!r} names parent "
+                           f"{parent!r} but no such span exists on its "
+                           "thread",
+                    "event": s,
+                })
+            elif not any(
+                q["t"] - _NEST_EPS_S <= s["t"]
+                and s["t"] + s["dur_s"] <= q["t"] + q["dur_s"] + _NEST_EPS_S
+                for q in candidates
+            ):
+                findings.append({
+                    "check": "span_nesting",
+                    "msg": f"span {s.get('name')!r} "
+                           f"[t={s['t']:.3f}, dur={s['dur_s']:.3f}] is not "
+                           f"contained in any {parent!r} span on its "
+                           "thread (non-monotonic nesting)",
+                    "event": s,
+                })
+    return findings

@@ -84,28 +84,48 @@ def span(name: str, **fields):
 
 # --- Chrome trace export -----------------------------------------------------
 
-_SPAN_META = ("t", "ev", "name", "dur_s", "thread", "parent", "pid")
+_SPAN_META = ("t", "ev", "name", "dur_s", "thread", "parent", "pid",
+              "process_index")
 
 
 def chrome_trace(events: list[dict]) -> dict:
     """Fold ``span`` events into Chrome tracing's JSON object format
     (load via chrome://tracing or https://ui.perfetto.dev). Complete
     ("ph":"X") events, microsecond timestamps rebased to the earliest
-    event so the viewer opens at t=0; pid carries the emitting process
-    when recorded, tid the thread ident."""
+    event so the viewer opens at t=0; tid is the thread ident.
+
+    Tracks: every distinct ``(process_index, os pid)`` writer gets its own
+    synthetic trace pid — OS pids from different hosts can collide, so the
+    raw pid cannot be the track key in a merged multi-host log — with a
+    ``process_name`` metadata record naming the host and real pid, and
+    ``process_sort_index`` ordering tracks by host."""
     spans = [e for e in events if e.get("ev") == "span" and "dur_s" in e]
     if not spans:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     t0 = min(e["t"] for e in spans)
+    track_ids: dict[tuple, int] = {}
     out = []
     for e in spans:
+        key = (e.get("process_index", 0) or 0, e.get("pid", 0))
+        if key not in track_ids:
+            track_ids[key] = len(track_ids)
         out.append({
             "name": e.get("name", "?"),
             "ph": "X",
             "ts": (e["t"] - t0) * 1e6,
             "dur": e["dur_s"] * 1e6,
-            "pid": e.get("pid", 0),
+            "pid": track_ids[key],
             "tid": e.get("thread", 0),
             "args": {k: v for k, v in e.items() if k not in _SPAN_META},
         })
-    return {"traceEvents": out, "displayTimeUnit": "ms"}
+    meta = []
+    for (host, ospid), tpid in sorted(track_ids.items(), key=lambda kv: kv[1]):
+        meta.append({
+            "name": "process_name", "ph": "M", "pid": tpid,
+            "args": {"name": f"host {host} (pid {ospid})"},
+        })
+        meta.append({
+            "name": "process_sort_index", "ph": "M", "pid": tpid,
+            "args": {"sort_index": host},
+        })
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}

@@ -89,14 +89,17 @@ class Trainer:
     def __init__(self, cfg: Config, mesh=None, spatial: Optional[bool] = None):
         self.cfg = cfg.validate()
         # Run-scoped event log (featurenet_tpu.obs): installed first so
-        # every later warning/span of this construction is captured. Host 0
-        # only — a multi-process run would interleave per-host logs into
-        # one file (per-host merge is a roadmap follow-on).
-        if self.cfg.run_dir and jax.process_index() == 0:
+        # every later warning/span of this construction is captured. Every
+        # host initializes its own stream (host 0 keeps events.jsonl and
+        # owns run.json; host i writes events.<i>.jsonl) — the report
+        # layer merges them, so a multi-process run's data-wait is visible
+        # per host instead of host 0's view standing in for the mesh.
+        if self.cfg.run_dir:
             from featurenet_tpu.config import config_to_dict
 
             obs.init_run(self.cfg.run_dir,
-                         config=config_to_dict(self.cfg))
+                         config=config_to_dict(self.cfg),
+                         process_index=jax.process_index())
         if mesh is not None:
             self.mesh = mesh
         else:
@@ -706,6 +709,10 @@ class Trainer:
                 prefix="setup",
             )
             raise SystemExit(RESTART_EXIT_CODE)
+        # Full step budget reached: mark the run terminal so a live tail
+        # (`cli report --follow`) knows to stop re-polling. Segment exits
+        # above deliberately don't — the run continues in a fresh process.
+        obs.emit("run_end", step=int(step), total=total)
         return last
 
 

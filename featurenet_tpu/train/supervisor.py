@@ -109,9 +109,15 @@ def supervise(
 
     sink = None
     if run_dir:
-        from featurenet_tpu.obs.events import EventSink
+        from featurenet_tpu.obs.events import EventSink, events_filename
 
-        sink = EventSink(run_dir)
+        # The supervisor lives on host 0 and appends to host 0's stream —
+        # its child appends there too, from a different process, which is
+        # safe because every EventSink emit is one O_APPEND write() of one
+        # complete line (obs.events). The report treats the terminal
+        # "done"/"giving_up" phases as run-over, which is what stops a
+        # live `report --follow`.
+        sink = EventSink(run_dir, filename=events_filename(0))
 
     def record(phase: str, **fields) -> None:
         if sink is not None:

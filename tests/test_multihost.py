@@ -338,3 +338,48 @@ def test_multiprocess_checkpoint_resume_and_planned_restart(tmp_path):
     mgr = ocp.CheckpointManager(ckpt)
     assert mgr.latest_step() == 5
     mgr.close()
+
+
+def test_two_process_obs_per_host_streams_merge(tmp_path):
+    """Multi-host telemetry e2e: a 2-process run with a run_dir writes one
+    event stream per host (host 0 keeps events.jsonl + run.json, host 1
+    gets events.1.jsonl), every host's data-wait/dispatch/heartbeat lands,
+    and the merged report renders a per-host breakdown — the blind spot
+    where only host 0's telemetry survived is closed."""
+    run_dir = str(tmp_path / "obsrun")
+    over = {"global_batch": 8, "total_steps": 2, "run_dir": run_dir}
+    outs, codes = _retry_port(2, over)
+    assert codes == [0, 0], (codes, [o[-1500:] for o in outs])
+
+    assert os.path.exists(os.path.join(run_dir, "events.jsonl"))
+    assert os.path.exists(os.path.join(run_dir, "events.1.jsonl"))
+    assert os.path.exists(os.path.join(run_dir, "run.json"))
+
+    from featurenet_tpu.obs.report import (
+        build_report,
+        format_report,
+        load_events,
+        load_manifest,
+        validate_events,
+    )
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    assert {e["process_index"] for e in events} == {0, 1}
+    manifest = load_manifest(run_dir)
+    assert manifest["jax"]["process_count"] == 2
+
+    rep = build_report(events, manifest)
+    assert sorted(rep["hosts"]) == [0, 1]
+    for h in rep["hosts"].values():
+        assert h["steps"] == 2
+        assert "data_wait" in h["fractions"]  # every host's wait is seen
+        assert "heartbeat" in h
+    assert "host_skew" in rep
+    txt = format_report(rep)
+    assert "hosts: 2" in txt
+
+    # Both hosts completed the budget → terminal event per host, and the
+    # whole merged stream passes the schema lint.
+    assert sum(1 for e in events if e["ev"] == "run_end") == 2
+    assert validate_events(events, bad_lines=bad) == []

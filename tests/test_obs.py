@@ -105,11 +105,16 @@ def test_span_nesting_and_thread_safety(tmp_path):
     assert all(s["parent"] == "t_outer" for s in by_name["t_inner"])
     assert all(s["dur_s"] >= 0 for s in spans)
 
-    # Chrome export: one complete event per span, rebased to t=0.
+    # Chrome export: one complete event per span, rebased to t=0, plus
+    # per-track ("M") metadata naming each (host, pid) writer.
     trace = chrome_trace(events)
-    assert len(trace["traceEvents"]) == len(spans)
-    assert all(ev["ph"] == "X" and ev["ts"] >= 0
-               for ev in trace["traceEvents"])
+    complete = [ev for ev in trace["traceEvents"] if ev["ph"] == "X"]
+    assert len(complete) == len(spans)
+    assert all(ev["ts"] >= 0 for ev in complete)
+    meta = [ev for ev in trace["traceEvents"] if ev["ph"] == "M"]
+    assert {m["name"] for m in meta} == {
+        "process_name", "process_sort_index"
+    }
 
 
 def test_inactive_sink_is_noop():
@@ -251,8 +256,8 @@ def test_trainer_run_dir_end_to_end(tmp_path, capsys):
     events, bad = load_events(run_dir)
     assert bad == 0
     kinds = {e["ev"] for e in events}
-    assert {"run_start", "loop_start", "loop_end", "span", "gauge",
-            "metrics", "heartbeat"} <= kinds
+    assert {"run_start", "loop_start", "loop_end", "run_end", "span",
+            "gauge", "metrics", "heartbeat"} <= kinds
     names = {e.get("name") for e in events if e["ev"] == "span"}
     assert {"data_wait", "dispatch", "eval", "checkpoint",
             "checkpoint_save"} <= names
@@ -281,6 +286,11 @@ def test_trainer_run_dir_end_to_end(tmp_path, capsys):
     cli_main(["report", run_dir, "--json"])
     rep2 = json.loads(capsys.readouterr().out)
     assert rep2["breakdown"].keys() == rep["breakdown"].keys()
+
+    # The real run's telemetry passes the schema lint (the tier-1 guard
+    # that malformed events fail fast instead of corrupting reports).
+    cli_main(["report", run_dir, "--validate"])
+    assert '"validate": "ok"' in capsys.readouterr().out
 
 
 def test_run_without_run_dir_stays_dark(tmp_path):
@@ -417,3 +427,445 @@ def test_seg_ood_rotation_delta_vs_scale_control():
         [{"family": "rotation", "level": 5.0, "mean_iou": 0.5}], "mean_iou"
     )
     assert "delta_vs_scale_control" not in no_ctrl[0]
+
+
+# --- multi-host telemetry (PR 2) ---------------------------------------------
+
+
+def _write_stream(run_dir, filename, events):
+    with open(os.path.join(run_dir, filename), "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+
+def test_init_run_per_host_streams(tmp_path):
+    """Host i>0 writes its own events.<i>.jsonl and never touches
+    run.json (host 0 is the manifest's sole owner); the loader tags each
+    record with the stream it came from."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=1)
+    obs.gauge("g", 1)
+    obs.close_run()
+    assert os.path.exists(os.path.join(run_dir, "events.1.jsonl"))
+    assert not os.path.exists(os.path.join(run_dir, "events.jsonl"))
+    assert not os.path.exists(os.path.join(run_dir, "run.json"))
+
+    obs.init_run(run_dir, config={"name": "unit"}, process_index=0)
+    obs.emit("heartbeat")
+    obs.close_run()
+    assert os.path.exists(os.path.join(run_dir, "events.jsonl"))
+    assert os.path.exists(os.path.join(run_dir, "run.json"))
+
+    from featurenet_tpu.obs.report import load_events
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    gauge = next(e for e in events if e["ev"] == "gauge")
+    beat = next(e for e in events if e["ev"] == "heartbeat")
+    assert gauge["process_index"] == 1
+    assert beat["process_index"] == 0
+    # Both hosts' run_start spawns are visible.
+    assert sum(1 for e in events if e["ev"] == "run_start") == 2
+
+
+def _host_events(t0, offset, dw, steps=4):
+    return [
+        {"t": t0 + offset, "ev": "run_start"},
+        {"t": t0 + offset, "ev": "loop_start", "step": 0, "stop": steps,
+         "total": steps},
+        {"t": t0 + offset + 0.1, "ev": "span", "name": "data_wait",
+         "dur_s": dw},
+        {"t": t0 + offset + 0.1 + dw, "ev": "span", "name": "dispatch",
+         "dur_s": 0.2},
+        {"t": t0 + offset + 0.5, "ev": "heartbeat", "age_s": 0.5},
+        {"t": t0 + offset + 1.5, "ev": "heartbeat", "age_s": 1.0},
+        {"t": t0 + offset + 2.0, "ev": "loop_end", "step": steps,
+         "wall_s": 2.0},
+    ]
+
+
+def test_three_host_merged_log_aggregation(tmp_path):
+    """Synthetic 3-host run dir: the loader merges all streams by time and
+    tags records; the report carries per-host fractions, heartbeat gaps,
+    and cross-host skew — while the primary (host 0) view is unchanged by
+    the merge."""
+    from featurenet_tpu.obs.report import format_report, load_events
+
+    run_dir = str(tmp_path)
+    t0 = 1000.0
+    _write_stream(run_dir, "events.jsonl", _host_events(t0, 0.0, 0.5))
+    _write_stream(run_dir, "events.1.jsonl", _host_events(t0, 0.2, 1.0))
+    _write_stream(run_dir, "events.2.jsonl", _host_events(t0, 0.4, 0.25))
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    assert {e["process_index"] for e in events} == {0, 1, 2}
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts)  # merged by timestamp, not concatenated
+
+    rep = build_report(events)
+    # Primary host's sections are computed from its own stream only: the
+    # other hosts' loop_starts must not read as respawns of host 0.
+    assert rep["loop"]["windows"] == 1
+    assert rep["loop"]["truncated_windows"] == 0
+    assert rep["loop"]["steps"] == 4
+    assert rep["breakdown"]["data_wait"]["fraction"] == 0.25
+    # Respawn semantics survive the merge: one spawn per host must read
+    # as zero restarts, so the counter stays primary-host-scoped.
+    assert rep["process_starts"] == 1
+
+    hosts = rep["hosts"]
+    assert sorted(hosts) == [0, 1, 2]
+    assert hosts[1]["fractions"]["data_wait"] == 0.5
+    assert hosts[2]["fractions"]["data_wait"] == 0.125
+    assert hosts[0]["heartbeat"]["beats"] == 2
+    assert hosts[0]["heartbeat"]["max_gap_s"] == pytest.approx(1.0)
+    assert all(h["steps"] == 4 for h in hosts.values())
+
+    skew = rep["host_skew"]
+    assert skew["loop_start_skew_s"] == pytest.approx(0.4)
+    assert skew["data_wait_fraction"]["min"] == 0.125
+    assert skew["data_wait_fraction"]["max"] == 0.5
+    assert skew["data_wait_fraction"]["spread"] == pytest.approx(0.375)
+    assert "step_mismatch" not in skew
+
+    txt = format_report(rep)
+    assert "hosts: 3" in txt
+    assert "host skew" in txt
+
+    # A host falling out of step is surfaced, not averaged away.
+    _write_stream(run_dir, "events.2.jsonl", _host_events(t0, 0.4, 0.25,
+                                                          steps=3))
+    events2, _ = load_events(run_dir)
+    rep2 = build_report(events2)
+    assert rep2["host_skew"]["step_mismatch"] == {0: 4, 1: 4, 2: 3}
+    assert "STEP MISMATCH" in format_report(rep2)
+
+
+def test_report_per_host_only_layout(tmp_path, capsys):
+    """A run dir holding only non-zero hosts' streams (host 0 wrote to a
+    different filesystem) still loads and reports, anchored on the lowest
+    index present."""
+    from featurenet_tpu.cli import main as cli_main
+    from featurenet_tpu.obs.report import load_events
+
+    run_dir = str(tmp_path)
+    t0 = 50.0
+    _write_stream(run_dir, "events.1.jsonl", _host_events(t0, 0.0, 0.5))
+    _write_stream(run_dir, "events.2.jsonl", _host_events(t0, 0.1, 0.8))
+    events, bad = load_events(run_dir)
+    rep = build_report(events, bad_lines=bad)
+    assert rep["loop"]["steps"] == 4
+    assert rep["breakdown"]["data_wait"]["fraction"] == 0.25  # host 1
+    assert sorted(rep["hosts"]) == [1, 2]
+    cli_main(["report", run_dir])
+    assert "hosts: 2" in capsys.readouterr().out
+
+
+def test_cli_report_lists_what_it_found(tmp_path):
+    from featurenet_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="not a directory"):
+        cli_main(["report", str(tmp_path / "never_made")])
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit, match="directory is empty"):
+        cli_main(["report", str(empty)])
+    stale = tmp_path / "stale"
+    stale.mkdir()
+    (stale / "run.json").write_text("{}")
+    (stale / "trace.json").write_text("{}")
+    with pytest.raises(SystemExit, match="found: run.json, trace.json"):
+        cli_main(["report", str(stale)])
+
+
+def test_interleaved_sink_writers_never_shear_lines(tmp_path):
+    """Several EventSinks on the SAME file (the supervisor + supervised
+    child shape: independent O_APPEND fds) hammered concurrently: every
+    line must land whole — each emit is a single append write()."""
+    import threading as th
+
+    from featurenet_tpu.obs.report import load_events
+
+    run_dir = str(tmp_path / "run")
+    sinks = [obs.EventSink(run_dir) for _ in range(4)]
+    pad = "x" * 512  # long enough to straddle any buffering boundary
+
+    def worker(i):
+        for j in range(100):
+            sinks[i % 4].emit("gauge", name=f"w{i}", value=j, pad=pad)
+
+    threads = [th.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for s in sinks:
+        s.close()
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    assert len(events) == 800
+    counts: dict = {}
+    for e in events:
+        counts[e["name"]] = counts.get(e["name"], 0) + 1
+        assert e["pad"] == pad  # intact payload, not a resynced fragment
+    assert all(v == 100 for v in counts.values())
+
+
+def test_two_process_writers_line_atomic(tmp_path):
+    """Real cross-process interleaving (not just cross-fd): two python
+    processes append through EventSink simultaneously; the merged file
+    parses clean with every record intact."""
+    import subprocess
+    import sys
+
+    run_dir = str(tmp_path / "run")
+    code = (
+        "import sys\n"
+        "from featurenet_tpu.obs.events import EventSink\n"
+        "sink = EventSink(sys.argv[1])\n"
+        "for j in range(300):\n"
+        "    sink.emit('gauge', name='p' + sys.argv[2], value=j,\n"
+        "              pad='y' * 256)\n"
+        "sink.close()\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code, run_dir, str(i)],
+                         cwd=repo)
+        for i in range(2)
+    ]
+    assert [p.wait(timeout=120) for p in procs] == [0, 0]
+
+    from featurenet_tpu.obs.report import load_events
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    per_writer: dict = {}
+    for e in events:
+        per_writer.setdefault(e["name"], []).append(e["value"])
+    assert sorted(per_writer) == ["p0", "p1"]
+    assert all(sorted(v) == list(range(300)) for v in per_writer.values())
+
+
+def test_event_tail_incremental(tmp_path):
+    """The live tail consumes only newly appended COMPLETE lines: a torn
+    trailing line waits for the writer to finish it, nothing is ever
+    re-parsed, and a per-host stream appearing mid-run is discovered."""
+    from featurenet_tpu.obs.report import EventTail
+
+    d = str(tmp_path)
+    path = os.path.join(d, "events.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"t": 1.0, "ev": "gauge", "name": "g",
+                             "value": 1}) + "\n")
+    tail = EventTail(d)
+    assert [e["value"] for e in tail.poll()] == [1]
+    assert tail.poll() == []  # no new bytes, no work
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"t": 2.0, "ev": "gauge", "name": "g",
+                             "value": 2}) + "\n")
+        fh.write('{"t": 3.0, "ev": "gau')  # writer caught mid-line
+    assert [e["value"] for e in tail.poll()] == [2]
+    with open(path, "a") as fh:
+        fh.write('ge", "name": "g", "value": 3}\n')
+    assert [e["value"] for e in tail.poll()] == [3]
+    assert tail.bad == 0
+    with open(os.path.join(d, "events.1.jsonl"), "w") as fh:
+        fh.write(json.dumps({"t": 4.0, "ev": "heartbeat"}) + "\n")
+    new = tail.poll()
+    assert [e["ev"] for e in new] == ["heartbeat"]
+    assert new[0]["process_index"] == 1
+    assert len(tail.events) == 4
+
+
+def test_follow_report_renders_and_exits_on_run_end(tmp_path):
+    """--follow re-renders as the file grows and returns when a terminal
+    event (run_end) lands; the injected clock plays the writer."""
+    from featurenet_tpu.obs.report import follow_report
+
+    d = str(tmp_path)
+    path = os.path.join(d, "events.jsonl")
+    t0 = 100.0
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"t": t0, "ev": "loop_start", "step": 0,
+                             "stop": 2, "total": 2}) + "\n")
+    outputs: list = []
+
+    def clock(_interval):
+        with open(path, "a") as fh:
+            fh.write(json.dumps({"t": t0 + 0.5, "ev": "span",
+                                 "name": "data_wait", "dur_s": 0.5}) + "\n")
+            fh.write(json.dumps({"t": t0 + 1.0, "ev": "loop_end",
+                                 "step": 2, "wall_s": 1.0}) + "\n")
+            fh.write(json.dumps({"t": t0 + 1.0, "ev": "run_end",
+                                 "step": 2}) + "\n")
+
+    follow_report(d, interval=0.01, out=outputs.append, clock=clock,
+                  max_polls=50, clear=False)
+    assert any("follow exiting" in o for o in outputs)
+    assert any("data_wait" in o for o in outputs)  # re-rendered breakdown
+    # And a run with no terminal event stops at max_polls instead of
+    # spinning forever (the test-harness escape hatch).
+    hot = tmp_path / "hot"
+    hot.mkdir()
+    _write_stream(str(hot), "events.jsonl",
+                  [{"t": 1.0, "ev": "heartbeat"}])
+    follow_report(str(hot), interval=0.01, out=[].append,
+                  clock=lambda s: None, max_polls=2, clear=False)
+
+
+def test_gates_pass_fail_and_tolerance_edge():
+    from featurenet_tpu.obs import gates
+
+    base = {"gates": {"step_ms": {"value": 100.0, "tolerance": 0.10}}}
+    assert gates.evaluate_gates({"step_ms": 90.0}, base)["ok"]
+    # Tolerance edge: exactly at the limit passes; a hair over fails.
+    assert gates.evaluate_gates({"step_ms": 110.0}, base)["ok"]
+    r = gates.evaluate_gates({"step_ms": 110.01}, base)
+    assert not r["ok"] and r["failed"] == ["step_ms"]
+    assert r["gates"][0]["limit"] == pytest.approx(110.0)
+
+    # direction=min (throughputs): lower is the regression.
+    tb = {"gates": {"e2e_samples_per_sec": {"value": 1000.0,
+                                            "tolerance": 0.10}}}
+    assert gates.evaluate_gates({"e2e_samples_per_sec": 900.0}, tb)["ok"]
+    assert not gates.evaluate_gates({"e2e_samples_per_sec": 899.0},
+                                    tb)["ok"]
+
+    # Absolute slack is the only meaningful tolerance on a 0 baseline.
+    zb = {"gates": {"restarts": {"value": 0, "tolerance_abs": 1}}}
+    assert gates.evaluate_gates({"restarts": 1}, zb)["ok"]
+    assert not gates.evaluate_gates({"restarts": 2}, zb)["ok"]
+
+    # A pinned metric the report lacks must not pass silently.
+    r = gates.evaluate_gates({}, base)
+    assert not r["ok"] and r["gates"][0]["status"] == "missing"
+
+    # Flat {metric: value} baselines work (default tolerance/direction).
+    assert gates.evaluate_gates({"step_ms": 105.0}, {"step_ms": 100.0})["ok"]
+    txt = gates.format_gates(r)
+    assert "FAIL" in txt and "MISSING" in txt
+
+
+def _serving_run_events(t0=1000.0):
+    return [
+        {"t": t0, "ev": "loop_start", "step": 0, "stop": 4, "total": 4},
+        {"t": t0 + 0.1, "ev": "span", "name": "data_wait", "dur_s": 0.5},
+        {"t": t0 + 0.6, "ev": "span", "name": "dispatch", "dur_s": 0.2},
+        {"t": t0 + 2.0, "ev": "loop_end", "step": 4, "wall_s": 2.0},
+        {"t": t0 + 3.0, "ev": "span", "name": "infer_batch",
+         "dur_s": 0.010, "n": 32},
+        {"t": t0 + 3.1, "ev": "span", "name": "infer_batch",
+         "dur_s": 0.030, "n": 8},
+        {"t": t0 + 3.2, "ev": "run_end", "step": 4},
+    ]
+
+
+def test_cli_report_gate_exit_codes(tmp_path, capsys):
+    """Acceptance: --gate exits non-zero on an injected p99/data-wait
+    regression vs a pinned baseline, and passes its own numbers."""
+    from featurenet_tpu.cli import main as cli_main
+    from featurenet_tpu.obs import gates
+
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    _write_stream(run_dir, "events.jsonl", _serving_run_events())
+
+    # Pin a baseline stricter than this run: p99 30ms vs pinned 10ms and
+    # data-wait 25% vs pinned 10% are both regressions.
+    strict = str(tmp_path / "strict.json")
+    with open(strict, "w") as fh:
+        json.dump({"gates": {
+            "serving_p99_ms": {"value": 10.0, "tolerance": 0.10},
+            "data_wait_fraction": {"value": 0.10, "tolerance": 0.10},
+        }}, fh)
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["report", run_dir, "--gate", strict])
+    assert exc.value.code == 2
+    out = capsys.readouterr().out
+    assert "gate: FAIL" in out
+    assert "serving_p99_ms" in out and "data_wait_fraction" in out
+
+    # A baseline pinned from the run's own report passes (round-trip).
+    rep = build_report_dir(run_dir)
+    pin = gates.make_baseline(gates.report_gate_values(rep))
+    own = str(tmp_path / "own.json")
+    with open(own, "w") as fh:
+        json.dump(pin, fh)
+    cli_main(["report", run_dir, "--gate", own])  # must not raise
+    assert "gate: PASS" in capsys.readouterr().out
+
+    # An empty baseline is an operator error, said out loud.
+    hollow = str(tmp_path / "hollow.json")
+    with open(hollow, "w") as fh:
+        json.dump({}, fh)
+    with pytest.raises(ValueError, match="pins no gates"):
+        cli_main(["report", run_dir, "--gate", hollow])
+
+
+def test_validate_events_lint(tmp_path, capsys):
+    from featurenet_tpu.cli import main as cli_main
+    from featurenet_tpu.obs.report import validate_events
+
+    # Clean nesting: child inside its parent's interval.
+    clean = [
+        {"t": 1.0, "ev": "run_start"},
+        {"t": 10.0, "ev": "span", "name": "outer", "dur_s": 1.0,
+         "thread": 7},
+        {"t": 10.2, "ev": "span", "name": "inner", "dur_s": 0.5,
+         "thread": 7, "parent": "outer"},
+        {"t": 11.0, "ev": "gauge", "name": "g", "value": 1},
+    ]
+    assert validate_events(clean) == []
+
+    dirty = [
+        {"t": 1.0, "ev": "mystery"},                      # unknown kind
+        {"t": 2.0, "ev": "span", "name": "x"},            # no dur_s
+        {"t": 3.0, "ev": "gauge", "name": "g"},           # no value
+        {"t": 4.0, "ev": "span", "name": "neg", "dur_s": -0.5},
+        {"t": 10.0, "ev": "span", "name": "outer", "dur_s": 1.0,
+         "thread": 7},
+        {"t": 12.0, "ev": "span", "name": "escaped", "dur_s": 0.5,
+         "thread": 7, "parent": "outer"},                 # outside parent
+        {"t": 13.0, "ev": "span", "name": "orphan", "dur_s": 0.1,
+         "thread": 7, "parent": "never_was"},
+    ]
+    findings = validate_events(dirty, bad_lines=1)
+    checks = [f["check"] for f in findings]
+    for want in ("parse", "unknown_kind", "missing_fields",
+                 "negative_duration", "span_nesting", "orphan_parent"):
+        assert want in checks, (want, checks)
+
+    # CLI: a clean dir reports ok; a corrupted one exits non-zero.
+    good = str(tmp_path / "good")
+    os.makedirs(good)
+    _write_stream(good, "events.jsonl", clean)
+    cli_main(["report", good, "--validate"])
+    assert '"validate": "ok"' in capsys.readouterr().out
+    bad_dir = str(tmp_path / "bad")
+    os.makedirs(bad_dir)
+    _write_stream(bad_dir, "events.jsonl", dirty)
+    with pytest.raises(SystemExit, match="finding"):
+        cli_main(["report", bad_dir, "--validate"])
+
+
+def test_bench_gate_summary_and_self_check():
+    """bench.py's wiring: a summary yields a pin-ready baseline; the next
+    round's regressed summary fails against it, a steady one passes."""
+    from featurenet_tpu.obs import gates
+
+    round1 = {"value": 16600.0, "mfu": 0.31,
+              "serving_inferences_per_sec_per_chip": 48900.0,
+              "e2e_samples_per_sec": 9878.0, "spread_pct": 3.8}
+    vals = gates.bench_gate_values(round1)
+    assert "spread_pct" not in vals  # measurement quality is not perf
+    pin = gates.make_baseline(vals, tolerance=0.15)
+    assert pin["gates"]["value"]["direction"] == "min"
+
+    steady = dict(round1, value=16000.0)
+    assert gates.evaluate_gates(gates.bench_gate_values(steady), pin)["ok"]
+    regressed = dict(round1, value=10000.0)
+    res = gates.evaluate_gates(gates.bench_gate_values(regressed), pin)
+    assert not res["ok"] and res["failed"] == ["value"]
