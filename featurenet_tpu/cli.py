@@ -115,6 +115,13 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "budget)")
     p.add_argument("--debug-nans", action="store_true",
                    help="jax_debug_nans: fail fast on the op producing a NaN")
+    p.add_argument("--inject-faults", dest="inject_faults",
+                   help="chaos spec 'site[@counter=N],...' (featurenet_tpu"
+                        ".faults): deterministically inject failures — "
+                        "checkpoint_corrupt@save=2, sigterm@step=120, "
+                        "producer_crash@batch=40, sink_enospc@emit=10 … — "
+                        "to exercise the recovery paths; each fault fires "
+                        "once per run (markers in --run-dir)")
 
 
 def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
@@ -147,7 +154,7 @@ def _overrides(args) -> dict:
         "profile_dir", "tb_dir", "run_dir", "heartbeat_file", "seg_loss",
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
-        "augment_translate_vox", "init_from",
+        "augment_translate_vox", "init_from", "inject_faults",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
@@ -228,7 +235,7 @@ def _cfg_from_checkpoint(saved, args):
     # unsupervised resume inheriting it from the sidecar would die with
     # exit 75 mid-run and nothing would respawn it.
     for k in ("heartbeat_file", "profile_dir", "tb_dir", "run_dir",
-              "restart_every_steps"):
+              "restart_every_steps", "inject_faults"):
         over.setdefault(k, None)
     # Arch flags must reach the returned config too — check_identity above
     # already rejected real contradictions, so what flows through here is
@@ -540,6 +547,24 @@ def main(argv=None) -> None:
             fd, hb = tempfile.mkstemp(prefix="fn_heartbeat_")
             os.close(fd)
             hb_is_temp = True
+        if getattr(args, "inject_faults", None):
+            # The spec reaches every child unmodified (--inject-faults is
+            # an override flag; child_argv_from_cli strips only the
+            # supervision flags), and one-shot markers in run_dir keep a
+            # fault from re-firing across respawns. The supervisor process
+            # itself installs ONLY its own site (spawn_fail): child-side
+            # sites firing here — e.g. sink_enospc on the supervisor's
+            # EventSink, which also counts emits — would consume the
+            # one-shot marker without ever exercising the recovery path
+            # under test.
+            from featurenet_tpu import faults
+
+            try:
+                faults.install(args.inject_faults,
+                               state_dir=getattr(args, "run_dir", None),
+                               only={"spawn_fail"})
+            except ValueError as e:
+                raise SystemExit(f"--inject-faults: {e}")
         raw = argv if argv is not None else sys.argv[1:]
         try:
             result = supervise(
@@ -663,6 +688,7 @@ def main(argv=None) -> None:
             heartbeat_file=None,
             run_dir=None,
             restart_every_steps=None,
+            inject_faults=None,
             # Recalibration restores from checkpoint_dir (resume wins over
             # warm start) — re-running the persisted init_from would pay
             # the warm-start restore for nothing, and crash outright when

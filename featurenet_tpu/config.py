@@ -168,6 +168,15 @@ class Config:
     # `python -m featurenet_tpu.cli report <run_dir>`. None (default) =
     # no obs file I/O and zero dispatch-path overhead.
     run_dir: Optional[str] = None
+    # Fault injection (featurenet_tpu.faults): a comma-separated chaos
+    # spec like "checkpoint_corrupt@save=2,sigterm@step=120" that makes
+    # the run fail in a scripted, deterministic way so the recovery paths
+    # (checkpoint fallback, preemption resume, supervisor restart, sink
+    # degradation) are *tested* properties, not claims. None (default) =
+    # every injection site is a single attribute check — no step-loop
+    # overhead. One-shot markers live in run_dir, so a supervised run's
+    # respawned children don't re-fire the same fault.
+    inject_faults: Optional[str] = None
     # Liveness: when set, the Trainer touches this file at every confirmed
     # point of progress (a device readback, an eval, a checkpoint). A
     # supervisor (train.supervisor / `cli train --supervise`) watches the
@@ -203,6 +212,13 @@ class Config:
     def validate(self) -> "Config":
         if self.task not in ("classify", "segment"):
             raise ValueError(f"unknown task {self.task!r}")
+        if self.inject_faults:
+            # A typo'd site/counter must fail at config time — a spec that
+            # silently never fires makes a chaos test pass by testing
+            # nothing.
+            from featurenet_tpu import faults as _faults
+
+            _faults.parse_spec(self.inject_faults)
         if self.seg_loss not in ("balanced_ce", "ce_dice", "dice"):
             raise ValueError(f"unknown seg_loss {self.seg_loss!r}")
         if self.seg_input_context not in ("none", "proj", "proj_coords"):

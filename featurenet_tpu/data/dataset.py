@@ -20,12 +20,32 @@ from __future__ import annotations
 
 import threading
 import time
+import traceback
 from typing import Iterator
 
 import numpy as np
 
-from featurenet_tpu import obs
+from featurenet_tpu import faults, obs
 from featurenet_tpu.data.synthetic import generate_batch, to_wire
+
+
+class ProducerError(RuntimeError):
+    """A prefetch producer worker died; raised in the *consumer*.
+
+    Carries the worker id and the worker thread's formatted traceback in
+    the message, so the train loop's crash names the real culprit (the
+    cache read, the generator bug) instead of a bare queue timeout — and
+    never deadlocks the consumer waiting on a ticket that will never be
+    filled. The original exception is chained as ``__cause__``.
+    """
+
+    def __init__(self, worker: int, tb: str):
+        self.worker = worker
+        self.worker_traceback = tb
+        super().__init__(
+            f"prefetch producer worker {worker} died; worker traceback:\n"
+            f"{tb}"
+        )
 
 
 class SyntheticVoxelDataset:
@@ -241,6 +261,18 @@ def prefetch_to_device(
         try:
             it = iters[w]
             while True:
+                # Chaos sites (zero-cost when faults are off): a scripted
+                # worker death exercises the structured-error path below; a
+                # scripted hang starves the consumer so the supervisor's
+                # stale-heartbeat kill is the recovery that gets tested.
+                if faults.maybe_fail("producer_crash", batch=ticket):
+                    raise faults.InjectedFault(
+                        f"producer_crash at ticket {ticket}"
+                    )
+                if faults.maybe_fail("producer_hang", batch=ticket):
+                    while not stop.is_set():
+                        time.sleep(0.05)
+                    return
                 # Per-batch generation timing (obs gauge): how long this
                 # worker spent producing, independent of backpressure
                 # waits — the report's "is generation the bottleneck"
@@ -268,7 +300,12 @@ def prefetch_to_device(
                 ticket += W
             result: object = _WorkerDone()
         except BaseException as e:  # propagate to consumer, don't hang it
-            result = e
+            # Structured surfacing: the consumer re-raises a ProducerError
+            # whose message embeds THIS thread's traceback — the stack the
+            # operator needs is the worker's, not the train loop's.
+            err = ProducerError(w, traceback.format_exc())
+            err.__cause__ = e
+            result = err
         with cond:
             out[ticket] = result
             cond.notify_all()
@@ -306,6 +343,16 @@ def prefetch_to_device(
             if isinstance(item, _WorkerDone):
                 done_workers.add(nxt % W)
             elif isinstance(item, BaseException):
+                if isinstance(item, ProducerError):
+                    # The recovery breadcrumb: a supervised run's restart
+                    # verdict pairs with this warning in events.jsonl, so
+                    # the report shows *why* the child died.
+                    obs.warn(
+                        "producer_error",
+                        f"prefetch worker {item.worker} died: "
+                        f"{item.__cause__!r}",
+                        worker=item.worker,
+                    )
                 raise item
             else:
                 if sharding is not None:

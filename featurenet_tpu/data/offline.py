@@ -36,7 +36,7 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
-from featurenet_tpu import obs
+from featurenet_tpu import faults, obs
 from featurenet_tpu.data.stl import load_stl
 from featurenet_tpu.data.synthetic import (
     CLASS_NAMES,
@@ -47,6 +47,18 @@ from featurenet_tpu.data.synthetic import (
     random_orientation,
 )
 from featurenet_tpu.data.voxelize import voxelize
+
+
+def _maybe_cache_read_fault(ds) -> None:
+    """Shared ``cache_read_error`` injection site for both cache datasets:
+    counts gathers on the dataset instance and raises on the spec's Nth
+    (the shape of an mmapped shard vanishing under a live reader)."""
+    ds._reads = getattr(ds, "_reads", 0) + 1
+    if faults.maybe_fail("cache_read_error", read=ds._reads):
+        raise faults.InjectedFault(
+            f"cache_read_error at gather #{ds._reads} (the mmapped "
+            "shard behind this batch went away)"
+        )
 
 
 def _voxelize_stl_packed(args: tuple[str, int, str, bool]) -> np.ndarray:
@@ -678,6 +690,7 @@ class SegCacheDataset:
         unpacks once per batch, rotates voxels+seg jointly per sample
         (per-voxel targets must rotate with the part), repacks once.
         """
+        _maybe_cache_read_fault(self)
         g = self.rows[idx]
         sh, rw = self._shard_pos[g], self._row_in_shard[g]
         R = self.resolution
@@ -852,6 +865,7 @@ class VoxelCacheDataset:
         gone, and what remains is a memcpy of 32 KB/sample at 64³. Host
         pose augmentation (``rng`` given) unpacks once per batch, rotates,
         repacks once."""
+        _maybe_cache_read_fault(self)
         rows = self.rows[idx]
         cls = self._cls_pos[idx]
         R = self.resolution

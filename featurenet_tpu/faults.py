@@ -1,0 +1,207 @@
+"""Deterministic fault injection for the recovery paths (chaos layer).
+
+The supervisor + obs stack claims to recover from hung collectives, dead
+ranks, corrupt checkpoints, and preempted processes — but until this module
+every recovery path was exercised only by synthetic unit tests, never by a
+real injected failure inside a real run. ``faults`` makes "handles as many
+scenarios as you can imagine" (ROADMAP north star) a *tested* property: a
+run configured with ``Config.inject_faults`` / ``--inject-faults`` fails in
+a precisely scripted way, and the e2e tests assert the run still completes
+with the matching recovery event in its ``events.jsonl``.
+
+Spec DSL (comma-separated, one entry per site)::
+
+    checkpoint_corrupt@save=2,producer_hang@batch=40,sigterm@step=120
+
+Each entry is ``site[@counter=N]``: the fault fires the first time the
+site calls ``maybe_fail(site, counter=value)`` with ``value >= N``
+(counters are site-defined ordinals — the step number, the Nth save, the
+Nth emit; see ``SITES`` — and may stride past N: fused dispatch advances
+the step by k, worker w's tickets go w, w+W, …). A bare ``site`` fires on
+the site's first check. Every fault
+fires **once**: in-memory for the process, and — when ``install`` is given
+a ``state_dir`` — once per *run*, via a ``fault_<site>.fired`` marker file
+that respawned children (supervisor restarts re-exec the same argv, so the
+same spec) see and skip. That one-shot-per-run contract is what lets a
+supervised e2e inject a crash and still assert the run completes: attempt
+1 dies, attempt 2 finds the marker and runs clean.
+
+Zero overhead when off: ``maybe_fail`` with no plan installed is one module
+attribute load and a ``None`` check — no counters, no dict lookups, nothing
+in the step loop. The module imports only the stdlib so every layer
+(including ``obs.events``, which must stay backend-free) can use it.
+
+What firing *means* is owned by each injection site — this registry only
+answers "should site X fail now?". The sites and their recovery matrix are
+documented in README "Fault tolerance"; ``InjectedFault`` is the exception
+sites raise when the fault is an error (vs. a behavior like hanging or
+sending SIGTERM).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Optional
+
+
+class InjectedFault(RuntimeError):
+    """Stand-in for a real failure, raised by an injection site."""
+
+
+# Every site wired through the stack, with the counter its caller passes.
+# A spec naming an unknown site is a hard error at parse time: a typo'd
+# site would otherwise silently never fire and the chaos test would pass
+# by testing nothing.
+SITES = {
+    "checkpoint_corrupt": "save",        # Nth CheckpointManager.save
+    "checkpoint_restore_error": "restore",  # Nth restore attempt
+    "sigterm": "step",                   # exact train-loop step number
+    "producer_crash": "batch",           # prefetch ticket ordinal
+    "producer_hang": "batch",            # prefetch ticket ordinal
+    "cache_read_error": "read",          # Nth cache _gather call
+    "sink_enospc": "emit",               # Nth EventSink.emit
+    "spawn_fail": "spawn",               # Nth supervisor child spawn
+}
+
+
+def parse_spec(spec: str) -> dict[str, Optional[tuple[str, int]]]:
+    """``"a@k=1,b"`` → ``{"a": ("k", 1), "b": None}``; validates sites and
+    counter names so a typo fails the run at config time, not silently."""
+    out: dict[str, Optional[tuple[str, int]]] = {}
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        site, sep, trigger = entry.partition("@")
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} in inject spec {spec!r}; "
+                f"known sites: {', '.join(sorted(SITES))}"
+            )
+        if site in out:
+            raise ValueError(f"duplicate fault site {site!r} in {spec!r}")
+        if not sep:
+            out[site] = None
+            continue
+        name, eq, value = trigger.partition("=")
+        if not eq or not name:
+            raise ValueError(
+                f"malformed trigger {entry!r}: expected site@counter=N"
+            )
+        if name != SITES[site]:
+            raise ValueError(
+                f"site {site!r} counts {SITES[site]!r}, not {name!r} "
+                f"(in {entry!r})"
+            )
+        try:
+            n = int(value)
+        except ValueError:
+            raise ValueError(
+                f"trigger value in {entry!r} must be an integer"
+            ) from None
+        out[site] = (name, n)
+    if not out:
+        raise ValueError(f"empty fault spec {spec!r}")
+    return out
+
+
+class FaultPlan:
+    """One parsed spec + its fired-state (in-memory and on-disk markers).
+
+    ``only``: restrict the plan to these sites (the supervisor installs
+    the shared spec with ``only={"spawn_fail"}`` — the child-side sites
+    must fire in the *training* process, not in the supervisor whose
+    EventSink also counts emits)."""
+
+    def __init__(self, spec: str, state_dir: Optional[str] = None,
+                 only: Optional[set] = None):
+        self.spec = spec
+        self.sites = parse_spec(spec)
+        if only is not None:
+            self.sites = {k: v for k, v in self.sites.items() if k in only}
+        self.state_dir = os.path.abspath(state_dir) if state_dir else None
+        self._fired: set[str] = set()
+        self._lock = threading.Lock()
+
+    def _marker(self, site: str) -> Optional[str]:
+        if self.state_dir is None:
+            return None
+        return os.path.join(self.state_dir, f"fault_{site}.fired")
+
+    def check(self, site: str, counter: dict) -> bool:
+        entry = self.sites.get(site, False)
+        if entry is False or site in self._fired:
+            return False
+        if entry is not None:
+            name, value = entry
+            got = counter.get(name)
+            # Threshold crossing, not equality: counters may stride past N
+            # (a fused-dispatch loop advances step by k; worker w's prefetch
+            # tickets are w, w+W, …) and a trigger that can silently never
+            # fire makes a chaos test pass by testing nothing. One-shot
+            # state (in-memory + run-dir marker) bounds this to a single
+            # firing — a resumed run whose counter restarts past N relies
+            # on the marker, which is why Trainer anchors state_dir in
+            # run_dir/checkpoint_dir.
+            if got is None or got < value:
+                return False
+        with self._lock:
+            if site in self._fired:
+                return False
+            marker = self._marker(site)
+            if marker is not None and os.path.exists(marker):
+                # Fired by an earlier process of this run (a respawned
+                # child re-executes the same argv/spec) — one-shot holds
+                # across restarts.
+                self._fired.add(site)
+                return False
+            self._fired.add(site)
+            if marker is not None:
+                os.makedirs(self.state_dir, exist_ok=True)
+                with open(marker, "w") as fh:
+                    fh.write(json.dumps({"site": site, "pid": os.getpid(),
+                                         "counter": counter}))
+        # stderr, never obs.warn: sink_enospc fires *inside* EventSink.emit
+        # and an obs re-entry would recurse.
+        print(json.dumps({"fault_injected": site, "pid": os.getpid(),
+                          **counter}), file=sys.stderr)
+        return True
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def install(spec: Optional[str], state_dir: Optional[str] = None,
+            only: Optional[set] = None) -> None:
+    """Install the process-wide fault plan (replacing any previous one).
+    ``state_dir``: directory for cross-process one-shot markers — pass the
+    run_dir so a supervised run's respawned children don't re-fire.
+    ``only``: keep just these sites of the spec (see ``FaultPlan``). A
+    falsy ``spec`` uninstalls."""
+    global _plan
+    _plan = FaultPlan(spec, state_dir, only=only) if spec else None
+
+
+def uninstall() -> None:
+    global _plan
+    _plan = None
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def maybe_fail(site: str, **counter) -> bool:
+    """True when the installed plan says this site should fail now.
+
+    The off path — no plan installed — is a single attribute check, so
+    injection sites can live inside the train step loop and the event
+    sink's emit without measurable overhead.
+    """
+    plan = _plan
+    if plan is None:
+        return False
+    return plan.check(site, counter)

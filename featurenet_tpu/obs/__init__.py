@@ -34,7 +34,9 @@ and exits non-zero on a regression.
 
 With no run_dir configured every hook is a no-op behind a single ``None``
 check — no file I/O, no timestamps, no measurable train-step overhead.
-This package imports only the stdlib, so any layer may import it freely.
+This package imports only the stdlib (plus the equally dependency-free
+``featurenet_tpu.faults`` chaos registry), so any layer may import it
+freely.
 """
 
 from featurenet_tpu.obs.events import (

@@ -44,12 +44,15 @@ them.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import sys
 import threading
 import time
 from typing import Any, Optional
+
+from featurenet_tpu import faults
 
 MANIFEST_FILENAME = "run.json"
 EVENTS_FILENAME = "events.jsonl"
@@ -127,6 +130,7 @@ class EventSink:
         self.path = os.path.join(self.run_dir, filename)
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        self._emits = 0
         # Raw fd, O_APPEND: every emit below is exactly one os.write of one
         # complete line. POSIX append semantics make each such write land
         # at the (atomically advanced) end of file, so concurrent writers
@@ -152,15 +156,39 @@ class EventSink:
         with self._lock:
             if self._fd is None:
                 return
-            # Single unbuffered write per line (see __init__); no flush
-            # needed, so a crashed run's log is complete to the crash.
-            # Regular-file appends complete in one write() in practice; if
-            # the kernel ever returns short (ENOSPC boundary, quota), the
-            # atomicity of THIS line is already lost, so finishing the
-            # tail beats silently gluing it onto the next record.
-            view = memoryview(data)
-            while view:
-                view = view[os.write(self._fd, view):]
+            self._emits += 1
+            # Telemetry is never load-bearing: a write that fails at the
+            # OS level (ENOSPC, quota, a yanked network filesystem) must
+            # not crash training. Degrade to a no-op sink with exactly one
+            # stderr warning — the run keeps training dark, like a run
+            # that never had a run_dir. Exercised by the ``sink_enospc``
+            # injection site.
+            try:
+                if faults.maybe_fail("sink_enospc", emit=self._emits):
+                    raise OSError(errno.ENOSPC, "injected ENOSPC",
+                                  self.path)
+                # Single unbuffered write per line (see __init__); no
+                # flush needed, so a crashed run's log is complete to the
+                # crash. Regular-file appends complete in one write() in
+                # practice; if the kernel ever returns short (ENOSPC
+                # boundary, quota), the atomicity of THIS line is already
+                # lost, so finishing the tail beats silently gluing it
+                # onto the next record.
+                view = memoryview(data)
+                while view:
+                    view = view[os.write(self._fd, view):]
+            except OSError as e:
+                fd, self._fd = self._fd, None
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                print(json.dumps({
+                    "sink_error": f"event sink write failed "
+                    f"({type(e).__name__}: {e}); telemetry for this "
+                    "process goes dark, training continues",
+                    "path": self.path,
+                }), file=sys.stderr)
 
     def close(self) -> None:
         with self._lock:

@@ -366,11 +366,30 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             "stalls": phases.count("stall"),
             "restarts": phases.count("restart"),
             "planned_restarts": phases.count("planned_restart"),
+            "backoffs": phases.count("backoff"),
             "timeline": [
                 {"t": round(e["t"], 3), "phase": e.get("phase"),
                  **{k: v for k, v in e.items()
                     if k not in ("t", "ev", "phase")}}
                 for e in sup
+            ],
+        }
+    # Recovery events: preemptions drained to a checkpoint and restores
+    # that fell back past a corrupt step — every host's stream counts (a
+    # preempted host ≠ host 0 in general).
+    rec = [e for e in events
+           if e["ev"] in ("preempt", "checkpoint_fallback")]
+    if rec:
+        rep["recovery"] = {
+            "preempts": sum(e["ev"] == "preempt" for e in rec),
+            "checkpoint_fallbacks": sum(
+                e["ev"] == "checkpoint_fallback" for e in rec
+            ),
+            "timeline": [
+                {"t": round(e["t"], 3), "event": e["ev"],
+                 **{k: v for k, v in e.items()
+                    if k not in ("t", "ev", "pid")}}
+                for e in rec
             ],
         }
 
@@ -527,6 +546,15 @@ def format_report(rep: dict) -> str:
         for e in sup["timeline"]:
             detail = {k: v for k, v in e.items() if k not in ("t", "phase")}
             lines.append(f"  t={e['t']:.3f} {e['phase']} {detail or ''}")
+    rc = rep.get("recovery")
+    if rc:
+        lines.append(
+            f"recovery: {rc['preempts']} preemption(s), "
+            f"{rc['checkpoint_fallbacks']} checkpoint fallback(s)"
+        )
+        for e in rc["timeline"]:
+            detail = {k: v for k, v in e.items() if k not in ("t", "event")}
+            lines.append(f"  t={e['t']:.3f} {e['event']} {detail or ''}")
     sv = rep.get("serving_latency_ms")
     if sv:
         lines.append(
@@ -669,6 +697,10 @@ def follow_report(
 KNOWN_EVENT_KINDS = frozenset({
     "run_start", "run_end", "span", "gauge", "metrics", "warning",
     "heartbeat", "supervisor", "loop_start", "loop_end",
+    # Recovery events (the fault-tolerance layer): a SIGTERM drain that
+    # checkpointed and exited for a planned respawn, and a restore that
+    # fell back past a corrupt latest checkpoint.
+    "preempt", "checkpoint_fallback",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -680,6 +712,8 @@ REQUIRED_EVENT_FIELDS = {
     "loop_start": ("step",),
     "loop_end": ("step",),
     "metrics": ("kind",),
+    "preempt": ("step",),
+    "checkpoint_fallback": ("from_step", "to_step"),
 }
 
 # Wall-clock start stamps vs perf_counter durations: a parent records its

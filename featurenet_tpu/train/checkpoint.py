@@ -10,12 +10,14 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
+import sys
 from typing import Optional
 
 import jax
 import orbax.checkpoint as ocp
 
-from featurenet_tpu import obs
+from featurenet_tpu import faults, obs
 from featurenet_tpu.train.state import TrainState
 
 # Run-config sidecar written into the checkpoint directory: the checkpoint's
@@ -35,10 +37,47 @@ def load_run_config(directory: str):
         return config_from_dict(json.load(fh))
 
 
+class InjectedFaultMisfire(RuntimeError):
+    """An injection site fired but could not apply its effect — a bug in
+    the chaos layer itself, never swallowed."""
+
+
+def _step_dir(root: str, step: int) -> Optional[str]:
+    """The on-disk directory Orbax keeps ``step`` in (naming varies with
+    step_prefix/padding options across Orbax versions, so probe)."""
+    cand = os.path.join(root, str(step))
+    if os.path.isdir(cand):
+        return cand
+    for name in os.listdir(root):
+        digits = "".join(ch for ch in name if ch.isdigit())
+        full = os.path.join(root, name)
+        if digits and int(digits) == step and os.path.isdir(full):
+            return full
+    return None
+
+
+def _corrupt_step_dir(root: str, step: int) -> None:
+    """Injected-fault effect: truncate every file of a finalized step dir
+    (the on-disk shape of a crash mid-write / torn filesystem flush)."""
+    target = _step_dir(root, step)
+    if target is None:
+        raise InjectedFaultMisfire(
+            f"checkpoint_corrupt fired but no step dir for {step} in {root}"
+        )
+    for dirpath, _, files in os.walk(target):
+        for f in files:
+            path = os.path.join(dirpath, f)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                fh.truncate(size // 2)
+
+
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3, config=None):
         self._dir = os.path.abspath(directory)
         self._config = config
+        self._saves = 0
+        self._restores = 0
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -69,14 +108,45 @@ class CheckpointManager:
         }
         # Async save: this span is the host-blocking enqueue only; the
         # background write's completion is bounded by checkpoint_wait.
+        self._saves += 1
         with obs.span("checkpoint_save", step=step):
             self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        if faults.maybe_fail("checkpoint_corrupt", save=self._saves):
+            # Wait for the async write to finalize, then truncate the step
+            # dir — the on-disk shape of a crash landing mid-checkpoint.
+            self._mgr.wait_until_finished()
+            _corrupt_step_dir(self._dir, step)
 
-    def restore(self, state: TrainState, step: Optional[int] = None) -> TrainState:
-        """Restore into the shardings/dtypes of the live ``state`` template."""
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
+    def restore(self, state: TrainState, step: Optional[int] = None,
+                cleanup: bool = False) -> TrainState:
+        """Restore into the shardings/dtypes of the live ``state`` template.
+
+        Verify-on-restore with fallback: when ``step`` is None (resume from
+        latest) and the latest retained step is truncated/corrupt — a crash
+        landed mid-write, or the filesystem tore it — the restore walks
+        back through the older retained steps instead of killing the run
+        permanently, and emits a ``checkpoint_fallback`` event carrying
+        both step numbers. An *explicitly requested* step never falls back:
+        the caller named that step, silently handing back a different one
+        would be worse than the error.
+
+        ``cleanup``: also DELETE the newer steps that failed (the resumed
+        trainer will re-save those step numbers and Orbax refuses an
+        existing step). Only the resume-to-train caller
+        (``Trainer.resume_if_available``) passes True — a read-only
+        restore (eval, infer, ``restore_init`` warm start from a possibly
+        shared/foreign directory) must never destroy another run's
+        checkpoints on what might be a transient read error.
+        """
+        latest = step if step is not None else self._mgr.latest_step()
+        if latest is None:
             raise FileNotFoundError("no checkpoint to restore")
+        if step is not None:
+            candidates = [int(step)]
+        else:
+            candidates = sorted(
+                (int(s) for s in self._mgr.all_steps()), reverse=True
+            ) or [int(latest)]
         template = {
             "step": state.step,
             "params": state.params,
@@ -84,11 +154,56 @@ class CheckpointManager:
             "opt_state": state.opt_state,
         }
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
-        with obs.span("checkpoint_restore", step=int(step)):
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract)
-            )
-        return state.replace(**restored)
+        first_error: Optional[BaseException] = None
+        for s in candidates:
+            self._restores += 1
+            try:
+                if faults.maybe_fail("checkpoint_restore_error",
+                                     restore=self._restores):
+                    raise faults.InjectedFault(
+                        f"checkpoint_restore_error at step {s}"
+                    )
+                with obs.span("checkpoint_restore", step=s):
+                    restored = self._mgr.restore(
+                        s, args=ocp.args.StandardRestore(abstract)
+                    )
+            except Exception as e:  # orbax raises various system errors
+                if step is not None:
+                    raise
+                first_error = first_error or e
+                print(json.dumps({
+                    "checkpoint_warning": f"restore of step {s} failed "
+                    f"({type(e).__name__}: {e}); trying the previous "
+                    "retained step",
+                }), file=sys.stderr)
+                continue
+            if s != candidates[0]:
+                # Recovered on an older step. For the resume-to-train
+                # caller, drop the corrupt newer steps (left in place
+                # they'd collide when the resumed run saves those step
+                # numbers again — Orbax refuses an existing step); either
+                # way make the data loss visible — the event is what the
+                # e2e chaos tests (and operators) key on, and the stderr
+                # line survives even sink-less runs.
+                if cleanup:
+                    for bad in candidates[:candidates.index(s)]:
+                        try:
+                            self._mgr.delete(bad)
+                        except Exception:
+                            d = _step_dir(self._dir, bad)
+                            if d:
+                                shutil.rmtree(d, ignore_errors=True)
+                obs.emit("checkpoint_fallback", from_step=candidates[0],
+                         to_step=s, error=repr(first_error)[:300])
+                print(json.dumps({
+                    "checkpoint_fallback": {"from_step": candidates[0],
+                                            "to_step": s},
+                }), file=sys.stderr)
+            return state.replace(**restored)
+        raise RuntimeError(
+            f"every retained checkpoint failed to restore "
+            f"(steps {candidates})"
+        ) from first_error
 
     def restore_init(
         self, state: TrainState, step: Optional[int] = None

@@ -17,13 +17,15 @@ Reference parity: ``train.py`` ``main()`` (SURVEY.md §3.1), redesigned:
 from __future__ import annotations
 
 import collections
+import os
+import signal
 import time
 from typing import Optional
 
 import jax
 import numpy as np
 
-from featurenet_tpu import obs
+from featurenet_tpu import faults, obs
 from featurenet_tpu.config import Config
 from featurenet_tpu.data.dataset import (
     SyntheticVoxelDataset,
@@ -100,6 +102,15 @@ class Trainer:
             obs.init_run(self.cfg.run_dir,
                          config=config_to_dict(self.cfg),
                          process_index=jax.process_index())
+        # Chaos plan (featurenet_tpu.faults): installed before any layer
+        # that hosts an injection site runs. One-shot markers go to the
+        # run_dir (shared across a supervised run's respawns) so a fault
+        # fires once per RUN, not once per process.
+        if self.cfg.inject_faults:
+            faults.install(
+                self.cfg.inject_faults,
+                state_dir=self.cfg.run_dir or self.cfg.checkpoint_dir,
+            )
         if mesh is not None:
             self.mesh = mesh
         else:
@@ -539,7 +550,9 @@ class Trainer:
 
     def resume_if_available(self) -> int:
         if self.ckpt and self.ckpt.latest_step() is not None:
-            self.state = self.ckpt.restore(self.state)
+            # cleanup=True: this caller OWNS the directory and will re-save
+            # the step numbers a corrupt-latest fallback walked past.
+            self.state = self.ckpt.restore(self.state, cleanup=True)
             return int(self.state.step)
         return 0
 
@@ -604,6 +617,25 @@ class Trainer:
             num_workers=cfg.data_workers,
         )
         self.logger.start_window()
+        # Preemption handling: SIGTERM (the cloud scheduler's "you have a
+        # grace period" signal) flips a flag the loop checks at each step
+        # boundary; the run then checkpoints exactly-here and exits with
+        # RESTART_EXIT_CODE, so a supervised preemption is a *planned*
+        # restart (free — no failure budget burned) and an unsupervised
+        # one leaves a resumable checkpoint instead of losing the segment.
+        # Installed only in the main thread (signal.signal refuses
+        # elsewhere; a benchmark running Trainers off-thread keeps the
+        # default disposition).
+        self._preempted = False
+        prev_sigterm = None
+        try:
+            prev_sigterm = signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: setattr(self, "_preempted", True),
+            )
+        except ValueError:
+            pass
+        preempted = False
         # Loop window markers: the report attributes span time to the
         # step-time breakdown only between these two events.
         obs.emit("loop_start", step=start, stop=stop, total=total)
@@ -669,7 +701,20 @@ class Trainer:
                         self.ckpt.save(self.state)
                     self._heartbeat()
                 step = new_step
+                if faults.maybe_fail("sigterm", step=step):
+                    # Scripted preemption: a REAL signal through the real
+                    # handler, at the first step boundary >= N (fused
+                    # dispatch may stride past the exact step). The
+                    # run-dir marker keeps the resumed process — whose
+                    # steps also sit past N — from re-firing.
+                    os.kill(os.getpid(), signal.SIGTERM)
+                if self._preempted and step < total:
+                    preempted = True
+                    obs.emit("preempt", step=int(step))
+                    break
         finally:
+            if prev_sigterm is not None:
+                signal.signal(signal.SIGTERM, prev_sigterm)
             obs.emit("loop_end", step=int(step),
                      wall_s=time.perf_counter() - loop_t0)
             if stream is not None:
@@ -689,23 +734,39 @@ class Trainer:
             self.logger.flush()
         if self.ckpt:
             self.ckpt.wait()
-        if stop < total:
-            # Segment finished but the run hasn't: persist exactly-here
-            # state (the periodic save may not align with the cut) and ask
-            # the supervisor for a fresh process.
+        if preempted and self.ckpt is None:
+            # Drained, but nothing was persisted: exit 75 would tell a
+            # supervising caller "checkpointed, respawn me free", and the
+            # respawned run would restart from step 0 — repeated
+            # preemptions would then loop forever without burning the
+            # failure budget or preserving any progress. Die by the
+            # signal instead (the pre-handler disposition), which a
+            # supervisor correctly counts as a crash.
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+        if preempted or stop < total:
+            # Two ways out mid-run, one exit protocol: a finished segment
+            # (planned restart) or a SIGTERM preemption. Both persist
+            # exactly-here state (the periodic save may not align with the
+            # cut) and exit RESTART_EXIT_CODE, so the supervisor respawns
+            # the run as *planned* — a preemption must not burn the
+            # failure budget.
             from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE
 
-            if self.ckpt.latest_step() != int(self.state.step):
-                self.ckpt.save(self.state)
-                self.ckpt.wait()
-            # A completed save is confirmed progress: without this beat, a
-            # short segment (< max_inflight/eval/checkpoint cadence) would
-            # exit 75 having never beaten, and the supervisor would
-            # misclassify the planned restart as a startup failure.
-            self._heartbeat()
+            if self.ckpt is not None:
+                if self.ckpt.latest_step() != int(self.state.step):
+                    self.ckpt.save(self.state)
+                    self.ckpt.wait()
+                # A completed save is confirmed progress: without this
+                # beat, a short segment (< max_inflight/eval/checkpoint
+                # cadence) would exit 75 having never beaten, and the
+                # supervisor would misclassify the planned restart as a
+                # startup failure.
+                self._heartbeat()
             self.logger.log(
                 int(self.state.step),
-                {"planned_restart_exit": 1.0},
+                {"preempt_exit" if preempted else "planned_restart_exit":
+                 1.0},
                 prefix="setup",
             )
             raise SystemExit(RESTART_EXIT_CODE)

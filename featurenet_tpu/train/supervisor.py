@@ -27,11 +27,14 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
 import time
 from typing import Optional, Sequence
+
+from featurenet_tpu import faults
 
 
 def touch_heartbeat(path: str) -> None:
@@ -57,6 +60,70 @@ class SuperviseResult:
     planned: int = 0  # planned (restart_every_steps) respawns, not counted
 
 
+def _stream_offsets(run_dir: str) -> dict[str, int]:
+    """Byte size of every event stream right now — the window start for
+    per-child telemetry validation."""
+    from featurenet_tpu.obs.report import discover_event_files
+
+    return {
+        path: os.path.getsize(path)
+        for path, _ in discover_event_files(run_dir)
+    }
+
+
+# validate_events checks that count as crash evidence for the restart
+# verdict: records that are structurally corrupt (torn/garbage lines,
+# fields the report cannot fold, impossible durations). Span-nesting /
+# orphan-parent findings are deliberately EXCLUDED here: a sink that
+# degrades mid-run (real ENOSPC — by design "training continues") leaves
+# open parents whose close lines never landed, and restarting a run that
+# finished its budget because its telemetry went dark would invert the
+# "telemetry is never load-bearing" contract.
+_CORRUPTION_CHECKS = frozenset({
+    "parse", "unknown_kind", "missing_fields", "negative_duration",
+})
+
+
+def _telemetry_findings(run_dir: str, offsets: dict[str, int]) -> list[dict]:
+    """Schema-lint only the event lines appended since ``offsets`` (this
+    child's lifetime — an old torn line must not condemn every later
+    child). Same lint as ``cli report --validate``, narrowed to the
+    structural-corruption checks (``_CORRUPTION_CHECKS``)."""
+    from featurenet_tpu.obs.report import (
+        _parse_lines,
+        discover_event_files,
+        validate_events,
+    )
+
+    events: list[dict] = []
+    bad = 0
+    for path, idx in discover_event_files(run_dir):
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(offsets.get(path, 0))
+                data = fh.read()
+        except OSError:
+            continue
+        # A torn TRAILING fragment (no newline at EOF) is the legitimate
+        # signature of the sink's ENOSPC degrade path: the short write
+        # that killed the sink is the last thing the stream ever got, and
+        # the child then finished dark by design. Drop it uncounted (the
+        # same partial-trailing-line convention as the live tail's
+        # EventTail) — "telemetry went dark" must not be condemned as
+        # "telemetry is corrupt". Garbage *followed by more lines* still
+        # counts.
+        if data and not data.endswith(b"\n"):
+            data = data[:data.rfind(b"\n") + 1] if b"\n" in data else b""
+        # One parser for the stream format (obs.report._parse_lines): the
+        # report's --validate and this verdict must never disagree on the
+        # same bytes.
+        bad += _parse_lines(
+            data.decode("utf-8", errors="replace").splitlines(), idx, events
+        )
+    return [f for f in validate_events(events, bad_lines=bad)
+            if f.get("check") in _CORRUPTION_CHECKS]
+
+
 def _kill_tree(proc: subprocess.Popen) -> None:
     """SIGKILL the child's whole process group (it may own worker threads
     blocked in native code; nothing softer is guaranteed to land)."""
@@ -79,6 +146,9 @@ def supervise(
     grace_s: Optional[float] = None,
     log=print,
     run_dir: Optional[str] = None,
+    backoff_base_s: float = 1.0,
+    backoff_cap_s: float = 60.0,
+    validate_telemetry: bool = True,
 ) -> SuperviseResult:
     """Run ``argv`` under stall supervision; restart on stall or crash.
 
@@ -102,6 +172,18 @@ def supervise(
         timeline next to the child's own spans. Appends are line-atomic
         across processes (obs.events), so the two writers interleave
         safely.
+      backoff_base_s / backoff_cap_s: crash-loop backoff. Every *unplanned*
+        restart sleeps ``min(cap, base * 2**(n-1))`` (n = consecutive
+        unplanned restarts) with jitter in [0.5x, 1x) before respawning,
+        recorded as a ``backoff`` supervisor event — a deterministic crash
+        at full respawn speed would otherwise hammer the device/tunnel
+        and burn the whole restart budget in seconds. Planned restarts
+        (exit 75 after progress) respawn immediately and reset the streak.
+      validate_telemetry: with ``run_dir``, a child that exits 0 has the
+        event lines it appended schema-linted (the ``cli report
+        --validate`` rules); corrupt telemetry is crash evidence — the
+        "success" is not trusted, a ``telemetry_corrupt`` supervisor event
+        is recorded, and the child is restarted on the failure budget.
 
     Returns a ``SuperviseResult``; ``exit_code`` 0 means the child finished.
     """
@@ -130,6 +212,10 @@ def supervise(
     # init each round for the same exit. One retry tolerates a transient
     # (tunnel lease mid-release); two in a row is permanent.
     early_fails = 0
+    # Consecutive UNPLANNED respawns — the crash-loop backoff exponent.
+    consec_failures = 0
+    spawns = 0
+    rng = random.Random()  # jitter source; never drives test-visible counts
     while True:
         # Fresh heartbeat so a stale file from the previous child can't
         # trigger (or mask) a stall verdict for this one. Its mtime is the
@@ -139,7 +225,20 @@ def supervise(
         base_mtime = os.path.getmtime(heartbeat_file)
         started = time.monotonic()
         first_beat_seen = False
-        proc = subprocess.Popen(list(argv), start_new_session=True)
+        # Telemetry window for this child: only lines appended from here on
+        # are linted for the exit-0 verdict below.
+        offsets = (
+            _stream_offsets(run_dir)
+            if run_dir and validate_telemetry else {}
+        )
+        spawns += 1
+        spawn_argv = list(argv)
+        if faults.maybe_fail("spawn_fail", spawn=spawns):
+            # Scripted spawn failure: the child slot is filled by a process
+            # that dies instantly — the shape of a bad binary path, an
+            # exec refused by the OS, a container OOM-killed at start.
+            spawn_argv = [sys.executable, "-c", "raise SystemExit(13)"]
+        proc = subprocess.Popen(spawn_argv, start_new_session=True)
         log(json.dumps({"supervisor": "spawn", "pid": proc.pid,
                         "attempt": restarts + 1}))
         record("spawn", pid=proc.pid, attempt=restarts + 1)
@@ -166,7 +265,16 @@ def supervise(
                 elif time.monotonic() - started > grace:
                     stalled = True  # never came up at all
             elif age > stall_timeout_s:
-                stalled = True
+                # Re-read immediately before the verdict: a beat can land
+                # between the sample above and here (slow poll iteration,
+                # laggy shared-filesystem mtime) and a SIGKILL on a live,
+                # progressing child costs a full restart for nothing.
+                try:
+                    age = time.time() - os.path.getmtime(heartbeat_file)
+                except OSError:
+                    pass
+                if age > stall_timeout_s:
+                    stalled = True
             if stalled:
                 log(json.dumps({
                     "supervisor": "stall", "pid": proc.pid,
@@ -186,7 +294,28 @@ def supervise(
                 first_beat_seen = os.path.getmtime(heartbeat_file) > base_mtime
             except OSError:
                 pass
-        if not stalled and rc == 0:
+        telemetry_bad = False
+        if not stalled and rc == 0 and run_dir and validate_telemetry:
+            # Exit 0 is a *claim*; the event lines this child appended are
+            # the evidence. Torn/garbage telemetry means the child's final
+            # moments are untrustworthy (a wedged runtime can exit 0 from
+            # an atexit path) — treat it as a crash, on the budget.
+            try:
+                findings = _telemetry_findings(run_dir, offsets)
+            except Exception as e:  # the lint itself must never kill us
+                findings = []
+                log(json.dumps({"supervisor": "validate_error",
+                                "error": repr(e)}))
+            if findings:
+                telemetry_bad = True
+                log(json.dumps({
+                    "supervisor": "telemetry_corrupt",
+                    "findings": len(findings),
+                    "first": findings[0].get("msg"),
+                }))
+                record("telemetry_corrupt", findings=len(findings),
+                       first=findings[0].get("msg"))
+        if not stalled and rc == 0 and not telemetry_bad:
             log(json.dumps({"supervisor": "done", "restarts": restarts,
                             "stalls": stalls, "planned": planned}))
             record("done", restarts=restarts, stalls=stalls, planned=planned)
@@ -203,11 +332,12 @@ def supervise(
             # deterministic startup failure).
             planned += 1
             early_fails = 0
+            consec_failures = 0  # real progress ends any crash streak
             log(json.dumps({"supervisor": "planned_restart",
                             "count": planned}))
             record("planned_restart", count=planned)
             continue
-        if not stalled and not first_beat_seen:
+        if not stalled and not first_beat_seen and not telemetry_bad:
             early_fails += 1
             if early_fails >= 2:
                 log(json.dumps({
@@ -235,10 +365,27 @@ def supervise(
                 sink.close()
             return SuperviseResult(rc if rc else 1, restarts - 1, stalls,
                                    planned)
+        reason = ("stall" if stalled
+                  else "telemetry_corrupt" if telemetry_bad
+                  else f"exit_{rc}")
+        # Crash-loop backoff: exponential in the UNPLANNED-restart streak,
+        # jittered so a fleet of supervisors sharing a recovering
+        # dependency doesn't respawn in lockstep, capped (~backoff_cap_s)
+        # so a multi-day run's sporadic crashes never wait minutes.
+        consec_failures += 1
+        delay = min(backoff_cap_s,
+                    backoff_base_s * (2 ** (consec_failures - 1)))
+        delay *= 0.5 + 0.5 * rng.random()
+        if delay > 0:
+            log(json.dumps({"supervisor": "backoff",
+                            "delay_s": round(delay, 3),
+                            "consecutive_failures": consec_failures}))
+            record("backoff", delay_s=round(delay, 3),
+                   consecutive_failures=consec_failures)
+            time.sleep(delay)
         log(json.dumps({"supervisor": "restart", "attempt": restarts + 1,
-                        "reason": "stall" if stalled else f"exit_{rc}"}))
-        record("restart", attempt=restarts + 1,
-               reason="stall" if stalled else f"exit_{rc}")
+                        "reason": reason}))
+        record("restart", attempt=restarts + 1, reason=reason)
 
 
 def child_argv_from_cli(argv: Sequence[str], heartbeat_file: str) -> list[str]:

@@ -1,0 +1,532 @@
+"""Fault-injection registry + unit-level recovery paths.
+
+The e2e inject-and-recover runs live in test_recovery_e2e.py; this file
+covers the registry's semantics (DSL, one-shot, zero-overhead off) and each
+hardened layer in isolation: sink degradation, producer structured errors,
+checkpoint fallback, supervisor backoff / spawn-fail / telemetry verdict /
+stall re-read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from featurenet_tpu import faults, obs
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends with no process-wide fault plan."""
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# --- registry ----------------------------------------------------------------
+
+def test_spec_parse_and_errors():
+    spec = ("checkpoint_corrupt@save=2,producer_hang@batch=40,"
+            "sigterm@step=120,sink_enospc@emit=10")
+    parsed = faults.parse_spec(spec)
+    assert parsed["checkpoint_corrupt"] == ("save", 2)
+    assert parsed["sigterm"] == ("step", 120)
+    assert faults.parse_spec("producer_crash") == {"producer_crash": None}
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.parse_spec("tyop_site@x=1")
+    with pytest.raises(ValueError, match="counts 'step'"):
+        faults.parse_spec("sigterm@save=1")
+    with pytest.raises(ValueError, match="integer"):
+        faults.parse_spec("sigterm@step=soon")
+    with pytest.raises(ValueError, match="duplicate"):
+        faults.parse_spec("sigterm@step=1,sigterm@step=2")
+    with pytest.raises(ValueError, match="empty"):
+        faults.parse_spec(" , ")
+
+
+def test_maybe_fail_off_exact_match_and_one_shot():
+    # Off: nothing installed => False, always (the zero-overhead contract).
+    assert not faults.maybe_fail("sigterm", step=1)
+    faults.install("sigterm@step=3")
+    assert not faults.maybe_fail("sigterm", step=2)
+    assert not faults.maybe_fail("producer_crash", batch=3)  # other site
+    assert faults.maybe_fail("sigterm", step=3)
+    assert not faults.maybe_fail("sigterm", step=3)  # fired once
+    faults.install("producer_crash")  # bare site: first check fires
+    assert faults.maybe_fail("producer_crash", batch=7)
+    assert not faults.maybe_fail("producer_crash", batch=8)
+
+
+def test_trigger_is_threshold_crossing_not_equality():
+    """Counters may stride past N (fused dispatch: step += k; worker w's
+    tickets: w, w+W, …) — the trigger fires at the first value >= N, so a
+    spec can't silently never fire on an off-grid counter."""
+    faults.install("sigterm@step=120")
+    assert not faults.maybe_fail("sigterm", step=112)
+    assert faults.maybe_fail("sigterm", step=124)  # crossed, not equal
+    assert not faults.maybe_fail("sigterm", step=124)  # still one-shot
+    faults.install("sigterm@step=120")
+    assert not faults.maybe_fail("sigterm")  # counter not supplied
+
+
+def test_install_only_filters_sites():
+    """The supervisor installs the shared spec with only={'spawn_fail'}:
+    child-side sites must not fire (and burn their one-shot marker) in
+    the supervisor process."""
+    faults.install("sink_enospc@emit=1,spawn_fail@spawn=1",
+                   only={"spawn_fail"})
+    assert not faults.maybe_fail("sink_enospc", emit=1)
+    assert faults.maybe_fail("spawn_fail", spawn=1)
+
+
+def test_marker_makes_faults_one_shot_per_run(tmp_path):
+    """A respawned child re-executes the same argv (same spec); the marker
+    file in the shared state_dir is what keeps attempt 2 clean."""
+    d = str(tmp_path)
+    faults.install("producer_crash@batch=1", state_dir=d)
+    assert faults.maybe_fail("producer_crash", batch=1)
+    assert os.path.exists(tmp_path / "fault_producer_crash.fired")
+    # "New process": a fresh plan over the same run dir.
+    faults.install("producer_crash@batch=1", state_dir=d)
+    assert not faults.maybe_fail("producer_crash", batch=1)
+
+
+def test_config_validates_inject_spec():
+    from featurenet_tpu.config import get_config
+
+    with pytest.raises(ValueError, match="unknown fault site"):
+        get_config("smoke16", inject_faults="tyop@x=1")
+    cfg = get_config("smoke16", inject_faults="sigterm@step=5")
+    assert cfg.inject_faults == "sigterm@step=5"
+
+
+def test_cli_carries_inject_faults_and_keeps_it_ephemeral():
+    import argparse
+
+    from featurenet_tpu.cli import _overrides
+
+    ns = argparse.Namespace(inject_faults="sigterm@step=5")
+    assert _overrides(ns)["inject_faults"] == "sigterm@step=5"
+    # The checkpoint sidecar must not leak a chaos spec into later
+    # resumes/evals: _cfg_from_checkpoint nulls it like heartbeat_file.
+    import inspect
+
+    from featurenet_tpu import cli
+
+    src = inspect.getsource(cli._cfg_from_checkpoint)
+    assert "inject_faults" in src
+
+
+# --- obs sink degradation ----------------------------------------------------
+
+def test_sink_enospc_degrades_to_noop_with_one_warning(tmp_path, capsys):
+    from featurenet_tpu.obs.events import EventSink
+
+    sink = EventSink(str(tmp_path))
+    faults.install("sink_enospc@emit=2")
+    sink.emit("gauge", name="a", value=1)
+    sink.emit("gauge", name="a", value=2)  # injected ENOSPC fires here
+    sink.emit("gauge", name="a", value=3)  # already dark: silent no-op
+    sink.close()
+    err = capsys.readouterr().err
+    assert err.count("sink_error") == 1  # exactly one warning
+    lines = open(tmp_path / "events.jsonl").read().splitlines()
+    assert len(lines) == 1  # only the pre-fault emit landed
+    json.loads(lines[0])  # and it is a complete record
+
+
+def test_real_oserror_on_write_also_degrades(tmp_path, capsys, monkeypatch):
+    """The hardening is not injection-specific: any OSError from os.write
+    takes the same degrade path."""
+    from featurenet_tpu.obs import events as ev_mod
+
+    sink = ev_mod.EventSink(str(tmp_path))
+
+    def boom(fd, data):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(ev_mod.os, "write", boom)
+    sink.emit("gauge", name="a", value=1)  # must not raise
+    monkeypatch.undo()
+    sink.emit("gauge", name="a", value=2)  # dark, still no raise
+    sink.close()
+    assert "sink_error" in capsys.readouterr().err
+
+
+# --- producer resilience -----------------------------------------------------
+
+def test_producer_crash_surfaces_structured_error(tmp_path):
+    from featurenet_tpu.data import SyntheticVoxelDataset, prefetch_to_device
+    from featurenet_tpu.data.dataset import ProducerError
+
+    obs.init_run(str(tmp_path / "run"))
+    try:
+        faults.install("producer_crash@batch=1")
+        ds = SyntheticVoxelDataset(resolution=16, global_batch=2, seed=0)
+        it = prefetch_to_device(ds, num_workers=1)
+        next(it)  # ticket 0 is clean
+        with pytest.raises(ProducerError) as exc:
+            next(it)
+        # The consumer-side raise carries the WORKER's traceback and the
+        # original exception chained — the operator sees the real culprit.
+        assert "InjectedFault" in str(exc.value)
+        assert exc.value.worker == 0
+        assert isinstance(exc.value.__cause__, faults.InjectedFault)
+    finally:
+        obs.close_run()
+    events = [json.loads(l) for l in
+              open(tmp_path / "run" / "events.jsonl")]
+    warn = [e for e in events
+            if e["ev"] == "warning" and e["name"] == "producer_error"]
+    assert len(warn) == 1 and warn[0]["worker"] == 0
+
+
+def test_producer_hang_site_starves_but_close_returns(tmp_path):
+    import time
+
+    from featurenet_tpu.data import SyntheticVoxelDataset, prefetch_to_device
+
+    faults.install("producer_hang@batch=1")
+    ds = SyntheticVoxelDataset(resolution=16, global_batch=2, seed=0)
+    it = prefetch_to_device(ds, num_workers=1)
+    next(it)  # ticket 0 produced before the hang
+    # The worker is now hung (the real recovery is the supervisor's stale-
+    # heartbeat kill — e2e-tested); the consumer-side generator must still
+    # shut down cleanly, releasing the hung worker via the stop event.
+    t0 = time.monotonic()
+    it.close()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_cache_read_error_propagates_through_producer(tmp_path):
+    from featurenet_tpu.data.dataset import ProducerError, prefetch_to_device
+    from featurenet_tpu.data.offline import (
+        VoxelCacheDataset,
+        export_synthetic_cache,
+    )
+
+    out = str(tmp_path / "cache")
+    export_synthetic_cache(out, per_class=2, resolution=16, seed=7)
+    ds = VoxelCacheDataset(out, global_batch=4, split="train",
+                           augment=False, seed=0)
+    faults.install("cache_read_error@read=2")
+    it = prefetch_to_device(ds, num_workers=1)
+    next(it)
+    with pytest.raises(ProducerError, match="cache_read_error"):
+        next(it)
+        next(it)
+
+
+# --- checkpoint fallback -----------------------------------------------------
+
+def _tiny_state():
+    import jax
+
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.models.featurenet import FeatureNet, tiny_arch
+    from featurenet_tpu.train.state import create_state
+    from featurenet_tpu.train.steps import make_optimizer
+
+    cfg = get_config("smoke16")
+    model = FeatureNet(arch=tiny_arch())
+    sample = np.zeros((2, 16, 16, 16, 1), np.float32)
+    return create_state(model, make_optimizer(cfg), sample,
+                        jax.random.key(0))
+
+
+def test_truncated_latest_step_falls_back_with_event(tmp_path):
+    """Satellite: truncate the latest Orbax step dir on disk; restore()
+    must fall back one step and the checkpoint_fallback event must carry
+    both step numbers."""
+    import jax.numpy as jnp
+
+    from featurenet_tpu.train.checkpoint import CheckpointManager, _step_dir
+
+    state = _tiny_state()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(state.replace(step=jnp.asarray(1, jnp.int32)), step=1)
+    mgr.save(state.replace(step=jnp.asarray(2, jnp.int32)), step=2)
+    mgr.wait()
+    step2 = _step_dir(str(tmp_path / "ck"), 2)
+    assert step2 is not None
+    for dirpath, _, files in os.walk(step2):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            with open(p, "r+b") as fh:
+                fh.truncate(os.path.getsize(p) // 2)
+
+    obs.init_run(str(tmp_path / "run"))
+    try:
+        # cleanup=True is what the resume-to-train caller passes (it will
+        # re-save the walked-past step numbers; Orbax refuses collisions).
+        restored = mgr.restore(state, cleanup=True)
+    finally:
+        obs.close_run()
+    assert int(restored.step) == 1
+    assert mgr.latest_step() == 1  # the corrupt step dir was dropped
+    events = [json.loads(l) for l in
+              open(tmp_path / "run" / "events.jsonl")]
+    fb = [e for e in events if e["ev"] == "checkpoint_fallback"]
+    assert len(fb) == 1
+    assert fb[0]["from_step"] == 2 and fb[0]["to_step"] == 1
+    mgr.close()
+
+
+def test_injected_restore_error_falls_back_without_disk_damage(tmp_path):
+    import jax.numpy as jnp
+
+    from featurenet_tpu.train.checkpoint import CheckpointManager
+
+    state = _tiny_state()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(state.replace(step=jnp.asarray(1, jnp.int32)), step=1)
+    mgr.save(state.replace(step=jnp.asarray(2, jnp.int32)), step=2)
+    mgr.wait()
+    faults.install("checkpoint_restore_error@restore=1")
+    restored = mgr.restore(state)
+    assert int(restored.step) == 1
+    # Default (read-only callers: eval/infer/warm start) never deletes —
+    # a transient read error must not destroy another run's checkpoints.
+    assert mgr.latest_step() == 2
+    mgr.close()
+
+
+def test_explicit_step_request_never_falls_back(tmp_path):
+    import jax.numpy as jnp
+
+    from featurenet_tpu.train.checkpoint import CheckpointManager
+
+    state = _tiny_state()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(state.replace(step=jnp.asarray(1, jnp.int32)), step=1)
+    mgr.save(state.replace(step=jnp.asarray(2, jnp.int32)), step=2)
+    mgr.wait()
+    faults.install("checkpoint_restore_error@restore=1")
+    with pytest.raises(faults.InjectedFault):
+        mgr.restore(state, step=2)  # the caller named it: error, not swap
+    mgr.close()
+
+
+def test_all_checkpoints_corrupt_raises_chained(tmp_path):
+    from featurenet_tpu.train.checkpoint import CheckpointManager
+
+    state = _tiny_state()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(state, step=1)
+    mgr.wait()
+    faults.install("checkpoint_restore_error@restore=1")
+    with pytest.raises(RuntimeError, match="every retained checkpoint"):
+        mgr.restore(state)
+    mgr.close()
+
+
+# --- supervisor: backoff, spawn_fail, telemetry verdict, stall re-read -------
+
+def _records_log():
+    records = []
+
+    def log(line):
+        records.append(json.loads(line))
+
+    return records, log
+
+
+def test_supervisor_backoff_grows_and_is_capped(tmp_path):
+    from featurenet_tpu.train.supervisor import supervise
+
+    hb = tmp_path / "hb"
+    # Beats, then crashes — every restart is an unplanned one.
+    code = (
+        "import os, sys, time\n"
+        f"hb={str(hb)!r}\n"
+        "time.sleep(0.2); os.utime(hb, None); time.sleep(0.1); sys.exit(9)\n"
+    )
+    records, log = _records_log()
+    res = supervise(
+        [sys.executable, "-c", code],
+        stall_timeout_s=10,
+        max_restarts=3,
+        heartbeat_file=str(hb),
+        poll_s=0.05,
+        log=log,
+        backoff_base_s=0.05,
+        backoff_cap_s=0.12,
+        run_dir=str(tmp_path / "run"),
+    )
+    assert res.exit_code == 9 and res.restarts == 3
+    backoffs = [r for r in records if r.get("supervisor") == "backoff"]
+    assert len(backoffs) == 3
+    delays = [b["delay_s"] for b in backoffs]
+    assert [b["consecutive_failures"] for b in backoffs] == [1, 2, 3]
+    # Jitter keeps delays in [0.5x, 1x) of the exponential; the cap binds
+    # the third (0.05 * 4 = 0.2 > 0.12).
+    assert 0.025 <= delays[0] <= 0.05
+    assert delays[2] <= 0.12
+    # And the same decisions landed in the run's event log.
+    events = [json.loads(l) for l in
+              open(tmp_path / "run" / "events.jsonl")]
+    phases = [e["phase"] for e in events if e["ev"] == "supervisor"]
+    assert phases.count("backoff") == 3
+
+
+def test_supervisor_planned_restart_skips_backoff_and_resets(tmp_path):
+    from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE, supervise
+
+    hb = tmp_path / "hb"
+    attempts = tmp_path / "attempts"
+    code = (
+        "import os, sys, time\n"
+        f"a={str(attempts)!r}; hb={str(hb)!r}\n"
+        "n = len(open(a).read()) if os.path.exists(a) else 0\n"
+        "open(a, 'a').write('x')\n"
+        "time.sleep(0.2); os.utime(hb, None)\n"
+        f"sys.exit(0 if n >= 2 else {RESTART_EXIT_CODE})\n"
+    )
+    records, log = _records_log()
+    res = supervise(
+        [sys.executable, "-c", code],
+        stall_timeout_s=10,
+        max_restarts=0,
+        heartbeat_file=str(hb),
+        poll_s=0.05,
+        log=log,
+    )
+    assert res.exit_code == 0 and res.planned == 2
+    assert not any(r.get("supervisor") == "backoff" for r in records)
+
+
+def test_supervisor_spawn_fail_site_burns_one_attempt(tmp_path):
+    from featurenet_tpu.train.supervisor import supervise
+
+    faults.install("spawn_fail@spawn=1")
+    records, log = _records_log()
+    res = supervise(
+        [sys.executable, "-c", "pass"],
+        stall_timeout_s=5,
+        max_restarts=3,
+        heartbeat_file=str(tmp_path / "hb"),
+        poll_s=0.05,
+        log=log,
+        backoff_base_s=0.01,
+    )
+    # Attempt 1 is the injected instantly-dying stub (exit 13, no beat);
+    # attempt 2 is the real child, which finishes.
+    assert res.exit_code == 0
+    assert res.restarts == 1
+    assert any(r.get("reason") == "exit_13" for r in records
+               if r.get("supervisor") == "restart")
+
+
+def test_supervisor_telemetry_corrupt_counts_as_crash(tmp_path):
+    """Satellite: a child that exits 0 but wrote torn telemetry is not
+    trusted — telemetry_corrupt is recorded and the child restarts on the
+    failure budget; the clean retry ends the run."""
+    from featurenet_tpu.train.supervisor import supervise
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    attempts = tmp_path / "attempts"
+    hb = tmp_path / "hb"
+    code = (
+        "import os, time\n"
+        f"a={str(attempts)!r}; hb={str(hb)!r}\n"
+        f"ev={str(run_dir / 'events.jsonl')!r}\n"
+        "n = len(open(a).read()) if os.path.exists(a) else 0\n"
+        "open(a, 'a').write('x')\n"
+        "time.sleep(0.2); os.utime(hb, None)\n"
+        "if n == 0:\n"
+        "    open(ev, 'a').write('{torn json garbage\\n')\n"
+    )
+    records, log = _records_log()
+    res = supervise(
+        [sys.executable, "-c", code],
+        stall_timeout_s=10,
+        max_restarts=3,
+        heartbeat_file=str(hb),
+        poll_s=0.05,
+        log=log,
+        run_dir=str(run_dir),
+        backoff_base_s=0.01,
+    )
+    assert res.exit_code == 0
+    assert res.restarts == 1
+    tc = [r for r in records if r.get("supervisor") == "telemetry_corrupt"]
+    assert len(tc) == 1 and tc[0]["findings"] >= 1
+    restart = [r for r in records if r.get("supervisor") == "restart"]
+    assert restart and restart[0]["reason"] == "telemetry_corrupt"
+    # The verdict is windowed: attempt 2's lint does NOT re-count attempt
+    # 1's garbage (or the run could never complete) — proven by exit 0.
+    events = [json.loads(l) for l in open(run_dir / "events.jsonl")
+              if not l.startswith("{torn")]
+    phases = [e.get("phase") for e in events if e.get("ev") == "supervisor"]
+    assert "telemetry_corrupt" in phases and "done" in phases
+
+
+def test_telemetry_lint_tolerates_torn_trailing_fragment(tmp_path):
+    """A torn fragment at EOF is the legitimate signature of the sink's
+    ENOSPC degrade path (short write, then dark by design) — it must NOT
+    read as corruption; a torn line FOLLOWED by more lines must."""
+    import time as _t
+
+    from featurenet_tpu.train.supervisor import _telemetry_findings
+
+    ev = tmp_path / "events.jsonl"
+    good = json.dumps({"t": _t.time(), "ev": "heartbeat"}) + "\n"
+    ev.write_text(good + '{"t": 123, "ev": "gau')  # short write at EOF
+    assert _telemetry_findings(str(tmp_path), {}) == []
+    ev.write_text('{torn mid-stream\n' + good)  # garbage, then more lines
+    findings = _telemetry_findings(str(tmp_path), {})
+    assert len(findings) == 1 and findings[0]["check"] == "parse"
+
+
+def test_stall_verdict_rereads_heartbeat_before_kill(tmp_path, monkeypatch):
+    """Satellite: a beat landing inside the final poll window must not
+    cause a spurious kill. Forced deterministically: the primary mtime
+    sample lies 'stale' exactly once; the verdict re-read sees the truth."""
+    import os.path as osp
+
+    from featurenet_tpu.train import supervisor as sup_mod
+
+    hb = tmp_path / "hb"
+    code = (
+        "import os, time\n"
+        f"hb={str(hb)!r}\n"
+        "for _ in range(30):\n"
+        "    open(hb, 'a').close(); os.utime(hb, None); time.sleep(0.05)\n"
+    )
+    real = osp.getmtime
+    state = {"base": None, "fresh_returns": 0, "lied": False}
+
+    def flaky_getmtime(path):
+        t = real(path)
+        if str(path) != str(hb):
+            return t
+        if state["base"] is None:
+            state["base"] = t  # the supervisor's baseline read
+            return t
+        if t > state["base"]:
+            if state["fresh_returns"] >= 1 and not state["lied"]:
+                # The supervisor has already seen a real beat (so
+                # first_beat_seen is set); THIS primary sample lies
+                # "ancient" — only the verdict re-read sees the truth.
+                state["lied"] = True
+                return t - 9999.0
+            state["fresh_returns"] += 1
+        return t
+
+    monkeypatch.setattr(sup_mod.os.path, "getmtime", flaky_getmtime)
+    res = sup_mod.supervise(
+        [sys.executable, "-c", code],
+        stall_timeout_s=1.0,
+        max_restarts=1,
+        heartbeat_file=str(hb),
+        poll_s=0.1,
+        grace_s=30.0,
+        log=lambda _: None,
+    )
+    assert state["lied"], "the stale-sample lie must have been exercised"
+    assert res.stalls == 0 and res.exit_code == 0

@@ -1,0 +1,242 @@
+"""End-to-end fault → recovery: inject via Config.inject_faults, assert the
+run COMPLETES with the matching recovery event in events.jsonl.
+
+One test per fault class of the recovery matrix (README "Fault tolerance"):
+
+  corrupt checkpoint → restore falls back a step   → checkpoint_fallback
+  SIGTERM preemption → drain-to-checkpoint, exit 75 → preempt + planned
+  producer death     → structured crash, restart    → producer_error + restart
+  sink ENOSPC        → telemetry dark, run finishes → stderr sink_error
+
+The supervised scenarios run a real supervisor over real spawned training
+processes (2-process: supervisor + child), so what is proven is the whole
+loop: fault fires → process-level recovery → Orbax resume → full step
+budget reached. Children are smoke16-sized (16³, tiny arch, ≤4 steps) to
+keep the tier-1 budget honest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import pytest
+
+from featurenet_tpu import faults, obs
+from featurenet_tpu.config import get_config
+from featurenet_tpu.train.loop import Trainer
+from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE, supervise
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+    obs.close_run()
+
+
+@pytest.fixture
+def no_persistent_compile_cache():
+    """Same rationale as test_train.py: a second Trainer over identical
+    computations in one process would execute executables deserialized
+    from the persistent cache, which fatally aborts in this sandbox."""
+    from jax._src import compilation_cache as cc
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    cc.reset_cache()
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+    cc.reset_cache()
+
+
+def _mini_cfg(tmp_path, **kw):
+    base = dict(
+        total_steps=4,
+        global_batch=8,
+        data_workers=1,
+        eval_batches=1,
+        log_every=10**9,
+        eval_every=10**9,
+        checkpoint_every=10**9,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        run_dir=str(tmp_path / "run"),
+    )
+    base.update(kw)
+    return get_config("smoke16", **base)
+
+
+def _events(tmp_path):
+    out = []
+    for line in open(os.path.join(str(tmp_path), "run", "events.jsonl")):
+        out.append(json.loads(line))
+    return out
+
+
+# --- fault class 1: corrupt checkpoint ---------------------------------------
+
+def test_e2e_corrupt_checkpoint_fallback_and_completion(
+        tmp_path, no_persistent_compile_cache):
+    """Run 1 saves at steps 2 and 4; the injected fault corrupts the step-4
+    checkpoint after it finalizes. Run 2 resumes: restore() must fall back
+    to step 2 (emitting checkpoint_fallback with both steps), retrain
+    2 → 4, and complete."""
+    cfg = _mini_cfg(
+        tmp_path,
+        checkpoint_every=2,
+        inject_faults="checkpoint_corrupt@save=2",
+    )
+    t1 = Trainer(cfg)
+    t1.run()
+    obs.close_run()
+    assert t1.ckpt.latest_step() == 4  # corrupt, but still the latest dir
+
+    t2 = Trainer(cfg)  # marker in run_dir keeps the fault one-shot
+    last = t2.run()
+    obs.close_run()
+    assert int(t2.state.step) == 4 and "loss" in last
+
+    events = _events(tmp_path)
+    fb = [e for e in events if e["ev"] == "checkpoint_fallback"]
+    assert len(fb) == 1
+    assert fb[0]["from_step"] == 4 and fb[0]["to_step"] == 2
+    # Run 2 really did restart from the fallback step...
+    starts = [e["step"] for e in events if e["ev"] == "loop_start"]
+    assert starts == [0, 2]
+    # ...and really did finish its full budget.
+    assert any(e["ev"] == "run_end" and e["step"] == 4 for e in events)
+
+
+# --- fault class 2: SIGTERM preemption (in-process drain) --------------------
+
+def test_preemption_drains_to_checkpoint_and_exits_75(tmp_path):
+    """The loop-level half of the preemption contract, without processes:
+    an injected SIGTERM (a real signal through the real handler) makes the
+    run checkpoint at the step boundary and exit RESTART_EXIT_CODE."""
+    cfg = _mini_cfg(tmp_path, inject_faults="sigterm@step=2",
+                    heartbeat_file=str(tmp_path / "hb"))
+    t = Trainer(cfg)
+    with pytest.raises(SystemExit) as e:
+        t.run()
+    obs.close_run()
+    assert e.value.code == RESTART_EXIT_CODE
+    assert t.ckpt.latest_step() == 2  # exactly-here state, not step 4
+    assert os.path.exists(tmp_path / "hb")  # beat: supervisor sees planned
+    pre = [e for e in _events(tmp_path) if e["ev"] == "preempt"]
+    assert len(pre) == 1 and pre[0]["step"] == 2
+
+
+# --- supervised scenarios: a real supervisor over real child processes -------
+
+_CHILD = """
+import json, sys
+from featurenet_tpu.config import get_config
+from featurenet_tpu.train.loop import Trainer
+over = json.loads(sys.argv[1])
+Trainer(get_config("smoke16", **over)).run()
+"""
+
+
+def _supervised(tmp_path, inject, total_steps=2, max_restarts=2):
+    """Run the full 2-process loop: supervise() in this process, training
+    children spawned from the CLI-equivalent entry (fresh JAX each)."""
+    hb = str(tmp_path / "hb")
+    over = dict(
+        total_steps=total_steps,
+        global_batch=8,
+        data_workers=1,
+        eval_batches=1,
+        log_every=10**9,
+        eval_every=10**9,
+        checkpoint_every=10**9,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        run_dir=str(tmp_path / "run"),
+        heartbeat_file=hb,
+        inject_faults=inject,
+    )
+    env_patch = {
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    }
+    old = {k: os.environ.get(k) for k in env_patch}
+    os.environ.update(env_patch)
+    records = []
+    try:
+        res = supervise(
+            [sys.executable, "-c", _CHILD, json.dumps(over)],
+            heartbeat_file=hb,
+            stall_timeout_s=120,
+            grace_s=600,
+            max_restarts=max_restarts,
+            poll_s=0.2,
+            backoff_base_s=0.05,
+            log=lambda s: records.append(json.loads(s)),
+            run_dir=str(tmp_path / "run"),
+        )
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return res, records
+
+
+def test_e2e_sigterm_preemption_resumes_as_planned(tmp_path):
+    """Satellite: supervisor + child; the child is SIGTERMed mid-run (the
+    injected fault delivers a real signal at step 1), exits 75, is
+    respawned as planned — not a counted restart — and resumes from the
+    preemption checkpoint to the full budget."""
+    res, records = _supervised(tmp_path, "sigterm@step=1", total_steps=2)
+    assert res.exit_code == 0
+    assert res.planned == 1  # the preemption was a FREE restart...
+    assert res.restarts == 0  # ...not one on the failure budget
+    events = _events(tmp_path)
+    pre = [e for e in events if e["ev"] == "preempt"]
+    assert len(pre) == 1 and pre[0]["step"] == 1
+    phases = [e.get("phase") for e in events if e["ev"] == "supervisor"]
+    assert "planned_restart" in phases and "done" in phases
+    # Child 2 resumed from the preemption checkpoint, then finished.
+    starts = [e["step"] for e in events if e["ev"] == "loop_start"]
+    assert starts == [0, 1]
+    assert any(e["ev"] == "run_end" and e["step"] == 2 for e in events)
+
+
+def test_e2e_producer_death_restart_and_completion(tmp_path):
+    """The prefetch producer dies mid-run (injected crash on its second
+    ticket): the train loop surfaces the worker's traceback (no deadlock),
+    the child exits nonzero, the supervisor backs off and restarts, the
+    fresh child (fault marker: one-shot per run) completes the budget."""
+    res, records = _supervised(tmp_path, "producer_crash@batch=1",
+                               total_steps=2)
+    assert res.exit_code == 0
+    assert res.restarts == 1 and res.planned == 0
+    events = _events(tmp_path)
+    warn = [e for e in events
+            if e["ev"] == "warning" and e.get("name") == "producer_error"]
+    assert len(warn) == 1 and warn[0]["worker"] == 0
+    phases = [e.get("phase") for e in events if e["ev"] == "supervisor"]
+    assert "backoff" in phases and "restart" in phases and "done" in phases
+    assert any(e["ev"] == "run_end" and e["step"] == 2 for e in events)
+
+
+# --- fault class 4: sink ENOSPC ----------------------------------------------
+
+def test_e2e_sink_enospc_training_survives(tmp_path, capsys):
+    """Telemetry is never load-bearing: the event sink hits (injected)
+    ENOSPC mid-run, degrades to a one-time stderr warning + no-op, and the
+    run still completes. The stream on disk stays whole-line valid."""
+    cfg = _mini_cfg(tmp_path, inject_faults="sink_enospc@emit=12")
+    t = Trainer(cfg)
+    last = t.run()
+    obs.close_run()
+    assert int(t.state.step) == 4 and "loss" in last
+    err = capsys.readouterr().err
+    assert err.count("sink_error") == 1
+    assert "fault_injected" in err
+    events = _events(tmp_path)  # every line before the fault parses clean
+    assert len(events) == 11  # emits 1..11 landed; #12 died; then dark
+    assert not any(e["ev"] == "run_end" for e in events)  # post-fault
