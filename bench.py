@@ -78,6 +78,36 @@ def _probe_backend() -> tuple[str, str | None]:
 def main() -> None:
     import os
 
+    # Contract self-check preamble (featurenet_tpu.analysis): the round
+    # measures the package's own dispatch path, so a violated cross-
+    # cutting contract — a typo'd fault site, an emit missing its schema
+    # fields, an unannotated hot-loop host sync — fails the round with a
+    # structured record (the same self-policing shape as the gate check
+    # below) instead of producing a number built on a broken invariant.
+    # Stdlib-only, runs before any jax import. Reproduce locally with:
+    #   python -m featurenet_tpu.cli lint
+    try:
+        from featurenet_tpu.analysis import run_lint
+
+        findings = run_lint()
+    except Exception as e:  # the linter must never mask the measurement
+        findings = []
+        print(json.dumps({"lint_error": repr(e)[:500]}))
+    if findings:
+        print(json.dumps({
+            "metric": "featurenet64_train_throughput",
+            "bench_schema": 2,
+            "skipped": True,
+            "reason": "contract_violation",
+            "lint": {
+                "findings": len(findings),
+                "first": f"{findings[0].location()}: "
+                         f"[{findings[0].rule}/{findings[0].check}] "
+                         f"{findings[0].msg}",
+            },
+        }))
+        return
+
     # Probe the backend BEFORE any in-process jax import: when the TPU is
     # unreachable (lease lapse, tunnel outage — BENCH_r05's rc=1 traceback
     # tail) the round must still end in one parseable JSON line, not a

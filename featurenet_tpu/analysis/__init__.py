@@ -1,0 +1,64 @@
+"""Repo-native static analysis: the cross-cutting contracts, machine-checked.
+
+Three PRs of growth (obs layer, multi-host telemetry, fault injection) left
+the package with *conventions* that nothing enforced: event kinds and their
+required fields are declared in ``obs/report.py`` while the emit sites are
+spread across ten files; ``faults.SITES`` declares injection sites whose
+``maybe_fail`` call sites thread through six modules (a typo'd site would
+silently never fire and the chaos test would pass by testing nothing —
+faults.py's own warning); and the hot training loop accumulates host-sync
+calls that serialize the dispatch pipeline the ROADMAP's north star depends
+on. This package turns those conventions into lint rules over the package's
+own AST — in the DL-framework-testing spirit of the reference lineage
+(PAPERS.md), pointed at ourselves.
+
+Rules (each a pure function over the parsed tree; see ``rules.py``):
+
+- ``telemetry``   — every ``emit``/``warn`` call site's literal event kind
+                    is in ``KNOWN_EVENT_KINDS`` and carries that kind's
+                    ``REQUIRED_EVENT_FIELDS`` as literal keyword keys; every
+                    known kind has at least one emit site (dead schema).
+- ``fault-sites`` — every ``maybe_fail("site", counter=...)`` literal names
+                    a ``faults.SITES`` entry with the declared counter; every
+                    declared site has at least one call site.
+- ``host-sync``   — ``.item()`` / ``jax.device_get`` / ``block_until_ready``
+                    / ``np.asarray`` inside the designated hot-path modules
+                    (train/loop.py, train/steps.py, infer.py), suppressible
+                    only via ``# lint: allow-host-sync(<reason>)``.
+- ``hygiene``     — wall-clock (``time.time()``) subtraction in duration
+                    arithmetic (must be ``perf_counter``; suppress with
+                    ``# lint: allow-wall-clock(<reason>)`` where epoch time
+                    is the point, e.g. file-mtime ages), bare ``except:``,
+                    and ``threading.Thread`` without an explicit ``daemon=``.
+- ``config-cli``  — every CLI override flag maps to a real ``Config`` field
+                    and every field is CLI-reachable or explicitly exempted
+                    (stale exemptions are themselves findings).
+
+Surfaced as ``python -m featurenet_tpu.cli lint [--json] [--rule NAME]``
+(exit 2 on findings) and run self-clean inside tier-1
+(``tests/test_analysis.py``), so deleting a ``maybe_fail`` call site or an
+emit field breaks the build, not the next chaos run. Everything here is
+stdlib + ``ast`` only — the linter must run where no backend exists (CI
+preambles, ``bench.py``'s self-check, a laptop without jax configured).
+"""
+
+from featurenet_tpu.analysis.lint import (
+    Finding,
+    RULE_NAMES,
+    format_findings,
+    package_root,
+    run_lint,
+)
+
+# Populate the rule registry at package-import time: RULE_NAMES/RULES are
+# part of the exported surface and must not read empty until the first
+# run_lint call lazily imports the rules.
+from featurenet_tpu.analysis import rules as _rules  # noqa: E402,F401
+
+__all__ = [
+    "Finding",
+    "RULE_NAMES",
+    "format_findings",
+    "package_root",
+    "run_lint",
+]

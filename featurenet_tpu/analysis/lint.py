@@ -1,0 +1,215 @@
+"""Lint engine: parse the package, run the contract rules, report findings.
+
+The engine is deliberately small: it walks a directory of ``.py`` files,
+parses each once (``ast`` + raw source lines, shared by every rule), runs
+the registered rules, and drops findings whose source line carries a
+matching suppression comment. Rules live in ``rules.py`` and are pure
+functions ``(tree) -> [Finding]`` — all repo-specific knowledge (which
+event kinds exist, which modules are hot paths) belongs there, not here.
+
+Suppression syntax — one per finding *kind*, never blanket::
+
+    x = np.asarray(dev_val)   # lint: allow-host-sync(readback is the point)
+    age = time.time() - mtime # lint: allow-wall-clock(mtime is epoch-based)
+
+The parenthesized reason is mandatory: an unexplained suppression is just
+the violation with extra steps. A suppression comment whose key doesn't
+match the finding on that line does nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow-([a-z0-9-]+)\(([^)]+)\)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str      # rule family ("telemetry", "host-sync", ...)
+    check: str     # specific check within the family ("unknown_kind", ...)
+    path: str      # path of the offending file (absolute)
+    line: int      # 1-indexed line (0 = whole-file / cross-file finding)
+    msg: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file, shared by every rule: path, AST, raw lines,
+    and the per-line suppression keys already extracted."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line number -> set of allow-keys on that line.
+        self.suppressions: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            for m in _SUPPRESS_RE.finditer(line):
+                self.suppressions.setdefault(i, set()).add(m.group(1))
+
+    def suppressed(self, lineno: int, key: str) -> bool:
+        """True when ``lineno`` (or a comment-only line directly above it)
+        carries ``# lint: allow-<key>(reason)``. The line-above form keeps
+        long statements readable; it must be a pure comment line so the
+        suppression can't accidentally cover two statements."""
+        if key in self.suppressions.get(lineno, ()):
+            return True
+        above = lineno - 1
+        if key in self.suppressions.get(above, ()):
+            text = self.lines[above - 1].strip() if above >= 1 else ""
+            return text.startswith("#")
+        return False
+
+
+class Tree:
+    """The whole lint target: every parsed module under one root."""
+
+    def __init__(self, root: str, modules: list[Module]):
+        self.root = root
+        self.modules = modules
+
+    def module(self, relpath: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+
+def package_root() -> str:
+    """Default lint target: the installed ``featurenet_tpu`` package."""
+    import featurenet_tpu
+
+    return os.path.dirname(os.path.abspath(featurenet_tpu.__file__))
+
+
+def load_tree(root: str) -> Tree:
+    root = os.path.abspath(root)
+    if not os.path.exists(root):
+        # A typo'd path must fail loudly: os.walk on a missing dir yields
+        # nothing and the "lint" would stay green forever.
+        raise FileNotFoundError(f"lint target {root!r} does not exist")
+    modules: list[Module] = []
+    if os.path.isfile(root):
+        with open(root, encoding="utf-8") as fh:
+            modules.append(Module(root, os.path.basename(root), fh.read()))
+        return Tree(os.path.dirname(root), modules)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(Module(path, os.path.relpath(path, root), source))
+    if not modules:
+        raise FileNotFoundError(
+            f"lint target {root!r} contains no .py files — wrong path?"
+        )
+    return Tree(root, modules)
+
+
+# Registered rule families, name -> callable; populated by rules.py at
+# import time (a plain dict, not entry points — the rule set IS the repo's
+# contract surface and changes only with the contracts themselves).
+RULES: dict[str, Callable[[Tree], list[Finding]]] = {}
+RULE_NAMES: list[str] = []
+
+
+def register(name: str):
+    def deco(fn):
+        RULES[name] = fn
+        RULE_NAMES.append(name)
+        return fn
+
+    return deco
+
+
+def _is_under(path: str, root: str) -> bool:
+    try:
+        return os.path.commonpath([path, root]) == root
+    except ValueError:  # different drives (windows) — never under
+        return False
+
+
+def run_lint(root: Optional[str] = None,
+             rules: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Lint ``root`` (default: the installed package) with the named rules
+    (default: all). Findings come back path/line-sorted, suppressions
+    already honored.
+
+    A ``root`` *inside* the installed package lints the WHOLE package and
+    narrows only the reported per-file findings to the requested subtree:
+    the contracts are package-wide, so relpaths must stay package-rooted
+    (``train/loop.py`` is a hot-path module no matter how it was named on
+    the command line) and the cross-file existence checks (dead event
+    kinds, dead fault sites, config/CLI drift) must see every file —
+    linting a subpath would otherwise both spray false dead-* positives
+    and silently skip the path-keyed rules. Package-level findings
+    (``line == 0``) always survive the narrowing: a dead fault site IS
+    this file's problem when this file held its last call site. A ``root``
+    outside the package is linted as its own tree (fixture snippets)."""
+    from featurenet_tpu.analysis import rules as _rules  # noqa: F401
+
+    pkg = package_root()
+    target = os.path.abspath(root) if root is not None else pkg
+    scope: Optional[str] = None
+    if target != pkg and _is_under(target, pkg):
+        scope = target
+        target = pkg
+    elif target != pkg and _is_under(pkg, target):
+        # `cli lint .` from a repo checkout: the package lives UNDER the
+        # target. Relpaths would come out 'featurenet_tpu/train/loop.py'
+        # and silently disarm every path-keyed rule, while the tests tree
+        # sprayed fixture noise — re-root to the package, which is the
+        # contract surface.
+        target = pkg
+    tree = load_tree(target)
+    selected = list(rules) if rules else list(RULE_NAMES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown lint rule(s) {unknown}; have {sorted(RULES)}"
+        )
+    findings: list[Finding] = []
+    for name in selected:
+        findings.extend(RULES[name](tree))
+    if scope is not None:
+        findings = [
+            f for f in findings
+            if f.line == 0 or f.path == scope or _is_under(f.path, scope)
+        ]
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings
+
+
+def format_findings(findings: list[Finding], as_json: bool = False) -> str:
+    """Text: one ``path:line rule/check message`` per finding. JSON: one
+    object per line plus a summary record — the same greppable-artifact
+    convention as the rest of the repo's tooling."""
+    if as_json:
+        lines = [json.dumps(f.to_dict()) for f in findings]
+        lines.append(json.dumps({
+            "lint": "fail" if findings else "ok",
+            "findings": len(findings),
+        }))
+        return "\n".join(lines)
+    if not findings:
+        return "lint: ok (0 findings)"
+    lines = [
+        f"{f.location()}: [{f.rule}/{f.check}] {f.msg}" for f in findings
+    ]
+    lines.append(f"lint: {len(findings)} finding(s)")
+    return "\n".join(lines)

@@ -1,0 +1,547 @@
+"""The five contract rules (see the package docstring for the catalog).
+
+Each rule is a pure function ``(Tree) -> [Finding]`` registered under its
+family name. The contract *sources* are imported, not duplicated: the
+telemetry rule reads ``KNOWN_EVENT_KINDS`` / ``REQUIRED_EVENT_FIELDS``
+straight from ``obs.report`` and the fault rule reads ``faults.SITES`` —
+both stdlib-only modules — so the linter can never drift from the schema it
+enforces. The one contract that cannot be imported cheaply is ``Config``
+(importing ``featurenet_tpu.config`` drags in the flax model zoo), so the
+config/CLI rule parses ``config.py``'s AST for the field list instead; the
+linter stays runnable where no ML stack exists.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from featurenet_tpu.analysis.lint import Finding, Module, Tree, register
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    """Trailing name of the called thing: ``emit`` for ``obs.emit(...)``
+    and for bare ``emit(...)``."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _call_owner(call: ast.Call) -> Optional[str]:
+    """``obs`` for ``obs.emit(...)``; None for a bare name call."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id
+    return None
+
+
+def _str_arg(call: ast.Call, index: int = 0) -> Optional[str]:
+    if len(call.args) > index:
+        a = call.args[index]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def _kwarg_names(call: ast.Call) -> set[str]:
+    return {kw.arg for kw in call.keywords if kw.arg is not None}
+
+
+# --- rule 1: telemetry contract ----------------------------------------------
+
+@register("telemetry")
+def telemetry_rule(tree: Tree) -> list[Finding]:
+    """Emit sites vs the event schema in ``obs.report``.
+
+    Every ``emit(...)`` whose kind is a string literal must name a known
+    kind and carry that kind's required fields as *literal keyword keys* —
+    a ``**splat`` doesn't count, because the schema check must be decidable
+    here, not at runtime. ``warn(...)`` sites are ``warning`` events with
+    ``name``/``msg`` as their leading positionals. Kinds with no emit site
+    anywhere are dead schema: either the event was removed without its
+    declaration, or the declaration was added without its producer.
+    """
+    from featurenet_tpu.obs.report import (
+        KNOWN_EVENT_KINDS,
+        REQUIRED_EVENT_FIELDS,
+    )
+
+    findings: list[Finding] = []
+    seen_kinds: set[str] = set()
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name == "warn":
+                # Only the obs layer's warn is under this contract: bare
+                # ``warn(...)`` (imported from obs) or ``obs.warn(...)``.
+                # Foreign warn APIs — ``warnings.warn``, a stdlib
+                # ``logger.warn`` — must not be forced into the telemetry
+                # schema.
+                if _call_owner(node) not in (None, "obs"):
+                    continue
+                seen_kinds.add("warning")
+                have = _kwarg_names(node)
+                # Positionals fill (name, msg) in order.
+                pos = ["name", "msg"][: len(node.args)]
+                missing = [
+                    f for f in REQUIRED_EVENT_FIELDS.get("warning", ())
+                    if f not in have and f not in pos
+                ]
+                if missing:
+                    findings.append(Finding(
+                        "telemetry", "missing_fields", mod.path, node.lineno,
+                        f"warn(...) site lacks required field(s) {missing} "
+                        "for its 'warning' event",
+                    ))
+                continue
+            if name != "emit":
+                continue
+            kind = _str_arg(node)
+            if kind is None:
+                # Generic forwarder (emit(ev, **fields)) — unresolvable
+                # here by design; the concrete sites it forwards are the
+                # ones checked.
+                continue
+            seen_kinds.add(kind)
+            if kind not in KNOWN_EVENT_KINDS:
+                findings.append(Finding(
+                    "telemetry", "unknown_kind", mod.path, node.lineno,
+                    f"emit of unknown event kind {kind!r} — add it to "
+                    "obs.report.KNOWN_EVENT_KINDS (and its required "
+                    "fields) or fix the typo",
+                ))
+                continue
+            have = _kwarg_names(node)
+            missing = [
+                f for f in REQUIRED_EVENT_FIELDS.get(kind, ())
+                if f not in have
+            ]
+            if missing:
+                findings.append(Finding(
+                    "telemetry", "missing_fields", mod.path, node.lineno,
+                    f"emit({kind!r}, ...) lacks required field(s) "
+                    f"{missing} as literal keyword keys "
+                    "(REQUIRED_EVENT_FIELDS); a **splat does not satisfy "
+                    "the static contract",
+                ))
+    for kind in sorted(KNOWN_EVENT_KINDS - seen_kinds):
+        findings.append(Finding(
+            "telemetry", "dead_schema", tree.root, 0,
+            f"event kind {kind!r} is declared in KNOWN_EVENT_KINDS but "
+            "has no emit site in the package (dead schema)",
+        ))
+    return findings
+
+
+# --- rule 2: fault-site cross-check ------------------------------------------
+
+@register("fault-sites")
+def fault_sites_rule(tree: Tree) -> list[Finding]:
+    """``maybe_fail`` call sites vs ``faults.SITES`` — both directions.
+
+    A call naming an undeclared site would never fire (the spec parser
+    rejects it before any run), and a declared site with no call site is a
+    chaos test that passes by testing nothing. The counter keyword must be
+    the declared one: ``maybe_fail("sigterm", save=n)`` would parse, fire
+    never, and look exactly like a passing test.
+    """
+    from featurenet_tpu.faults import SITES
+
+    findings: list[Finding] = []
+    called: set[str] = set()
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) != "maybe_fail":
+                continue
+            site = _str_arg(node)
+            if site is None:
+                continue  # the registry's own generic def/check paths
+            if site not in SITES:
+                findings.append(Finding(
+                    "fault-sites", "unknown_site", mod.path, node.lineno,
+                    f"maybe_fail site {site!r} is not declared in "
+                    "faults.SITES — the injection would never fire",
+                ))
+                continue
+            called.add(site)
+            declared = SITES[site]
+            have = _kwarg_names(node)
+            if declared not in have:
+                findings.append(Finding(
+                    "fault-sites", "missing_counter", mod.path, node.lineno,
+                    f"maybe_fail({site!r}, ...) does not pass the declared "
+                    f"counter {declared!r} — a threshold spec for this "
+                    "site could never fire",
+                ))
+            wrong = sorted(have - {declared})
+            if wrong:
+                findings.append(Finding(
+                    "fault-sites", "wrong_counter", mod.path, node.lineno,
+                    f"maybe_fail({site!r}, ...) passes counter(s) {wrong} "
+                    f"but the site declares {declared!r} (faults.SITES)",
+                ))
+    for site in sorted(set(SITES) - called):
+        findings.append(Finding(
+            "fault-sites", "dead_site", tree.root, 0,
+            f"faults.SITES declares {site!r} but no maybe_fail call site "
+            "exists — the chaos spec would install and test nothing",
+        ))
+    return findings
+
+
+# --- rule 3: host-sync discipline --------------------------------------------
+
+# Modules whose functions sit on (or next to) the dispatch hot path: every
+# host sync here serializes the pipeline, so each one must be deliberate
+# and say why. Package-relative paths.
+HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py")
+
+
+def _is_host_sync(node: ast.Call) -> Optional[str]:
+    """The human name of the sync construct, or None."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "item" and not node.args and not node.keywords:
+            return ".item()"
+        if f.attr == "device_get":
+            return "jax.device_get"
+        if f.attr == "block_until_ready":
+            return "block_until_ready"
+        if (f.attr == "asarray" and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy")):
+            return "np.asarray"
+    elif isinstance(f, ast.Name) and f.id == "block_until_ready":
+        return "block_until_ready"
+    return None
+
+
+@register("host-sync")
+def host_sync_rule(tree: Tree) -> list[Finding]:
+    """Host-device synchronization points in the designated hot-path
+    modules (``HOT_PATH_MODULES``): ``.item()``, ``jax.device_get``,
+    ``block_until_ready``, and ``np.asarray`` (which forces a readback
+    when handed a device value). Each one stalls the async dispatch
+    pipeline, so each must either go or carry
+    ``# lint: allow-host-sync(<reason>)`` naming why the sync is the
+    point (a progress-proof readback, an epilogue aggregation, a
+    host-side array that never saw the device).
+    """
+    findings: list[Finding] = []
+    for mod in tree.modules:
+        if mod.relpath not in HOT_PATH_MODULES:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _is_host_sync(node)
+            if what is None:
+                continue
+            if mod.suppressed(node.lineno, "host-sync"):
+                continue
+            findings.append(Finding(
+                "host-sync", "host_sync", mod.path, node.lineno,
+                f"{what} in hot-path module {mod.relpath} serializes the "
+                "dispatch pipeline — remove it or annotate the line with "
+                "# lint: allow-host-sync(<why this sync is deliberate>)",
+            ))
+    return findings
+
+
+# --- rule 4: concurrency / timing hygiene ------------------------------------
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "time"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "time"
+    )
+
+
+def _scope_nodes(scope: ast.AST):
+    """Direct nodes of one scope: walk the body but do not descend into
+    nested function/class scopes (each is analyzed on its own)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register("hygiene")
+def hygiene_rule(tree: Tree) -> list[Finding]:
+    """Timing and concurrency footguns the obs/faults layers already paid
+    for once each:
+
+    - ``time.time()`` as an operand of duration *subtraction* (directly,
+      or via a variable assigned from it in the same scope): wall clock
+      steps under NTP and corrupts mid-run durations — use
+      ``perf_counter``. Where epoch arithmetic is the point (file-mtime
+      ages), annotate ``# lint: allow-wall-clock(<reason>)``.
+    - bare ``except:`` — swallows KeyboardInterrupt/SystemExit, which the
+      supervisor's exit-code protocol depends on.
+    - ``threading.Thread`` without an explicit ``daemon=``: an implicit
+      non-daemon worker blocks interpreter exit exactly when the run is
+      being torn down by a fault.
+    """
+    findings: list[Finding] = []
+    for mod in tree.modules:
+        scopes = [mod.tree] + [
+            n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            # Every plain-name assignment in the scope, with whether it
+            # binds a wall-clock reading. Position-aware: a name counts as
+            # wall-clock at a use site only if its LAST assignment before
+            # that line was time.time() — `now = time.perf_counter()`
+            # after an earlier epoch stamp must not taint later math.
+            assigns: list[tuple[int, str, bool]] = []
+            for node in _scope_nodes(scope):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            assigns.append((node.lineno, t.id,
+                                            _is_time_time(node.value)))
+
+            def wall_at(name: str, lineno: int) -> bool:
+                last = None
+                for ln, n, wall in assigns:
+                    if n == name and ln < lineno and (
+                            last is None or ln > last[0]):
+                        last = (ln, wall)
+                return last is not None and last[1]
+
+            for node in _scope_nodes(scope):
+                if not (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.Sub)):
+                    continue
+                wall = any(
+                    _is_time_time(s)
+                    or (isinstance(s, ast.Name)
+                        and wall_at(s.id, node.lineno))
+                    for s in (node.left, node.right)
+                )
+                if not wall:
+                    continue
+                if mod.suppressed(node.lineno, "wall-clock"):
+                    continue
+                findings.append(Finding(
+                    "hygiene", "wall_clock_arith", mod.path, node.lineno,
+                    "duration arithmetic on time.time() — wall clock "
+                    "steps under NTP; use time.perf_counter(), or "
+                    "annotate # lint: allow-wall-clock(<reason>) where "
+                    "epoch time is the point",
+                ))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                if not mod.suppressed(node.lineno, "bare-except"):
+                    findings.append(Finding(
+                        "hygiene", "bare_except", mod.path, node.lineno,
+                        "bare except: swallows KeyboardInterrupt/"
+                        "SystemExit (the supervisor's exit protocol) — "
+                        "name the exception(s)",
+                    ))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                is_thread = (
+                    (isinstance(f, ast.Attribute) and f.attr == "Thread"
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id == "threading")
+                    or (isinstance(f, ast.Name) and f.id == "Thread")
+                )
+                if is_thread and "daemon" not in _kwarg_names(node):
+                    if not mod.suppressed(node.lineno, "thread-daemon"):
+                        findings.append(Finding(
+                            "hygiene", "thread_daemon", mod.path,
+                            node.lineno,
+                            "threading.Thread without explicit daemon= — "
+                            "an implicit non-daemon worker blocks "
+                            "interpreter exit during fault teardown",
+                        ))
+    return findings
+
+
+# --- rule 5: config / CLI drift ----------------------------------------------
+
+# CLI dests that deliberately do NOT name a Config field 1:1, mapped to the
+# field(s) they actually drive (empty tuple = none by design). This table
+# is part of the contract: a new indirection flag must be entered here or
+# the lint fails.
+FLAG_ALIASES: dict[str, tuple[str, ...]] = {
+    "config": (),           # preset selector, resolved before overrides
+    "debug_nans": (),       # flips a jax global, not run config
+    "supervise": (),        # supervisor-process policy, never a field
+    "stall_timeout": (),
+    "max_restarts": (),
+    "supervised_child": (),  # internal respawn marker
+    "no_augment": ("augment",),
+    "no_spatial": ("spatial",),
+    "no_augment_affine_rotate": ("augment_affine_rotate",),
+    "no_stem_s2d": ("arch",),        # arch.stem_s2d
+    "conv_backend": ("arch",),       # arch.conv_backend
+    # An explicit --steps-per-dispatch also opts out of the membytes clamp.
+    "steps_per_dispatch": ("steps_per_dispatch", "clamp_dispatch_k"),
+}
+
+# Config fields deliberately not reachable from the CLI, each with the
+# reason. The rule flags stale entries (a field that grew a flag, or was
+# deleted) so the whitelist can only shrink truthfully.
+CLI_EXEMPT_FIELDS: dict[str, str] = {
+    "name": "preset identity — selected via --config, never overridden",
+    "task": "preset-defined; a different task is a different preset",
+    "num_features": "dataset property owned by the seg presets/caches",
+    "eval_batches": "eval protocol constant (synthetic streaming only)",
+    "test_fraction": "split constant; per-run changes would desync splits",
+    "augment_device": "augmentation placement internal (device_augment)",
+    "augment_groups": "augmentation internal, preset-owned",
+    "seg_features": "arch identity, preset-owned",
+    "optimizer": "recipe field, preset-owned",
+    "weight_decay": "recipe field, preset-owned",
+    "warmup_steps": "recipe field, preset-owned",
+    "label_smoothing": "recipe field, preset-owned",
+    "mesh_data": "derived: all devices not claimed by mesh_model",
+    "max_inflight_steps": "dispatch backpressure internal",
+    "profile_start": "profiling window internal (profile_dir is the switch)",
+    "profile_steps": "profiling window internal",
+    "log_every": "cadence constant, preset-owned",
+    "eval_every": "cadence constant, preset-owned",
+    "checkpoint_every": "cadence constant, preset-owned",
+    "keep_checkpoints": "retention constant, preset-owned",
+}
+
+# The CLI functions whose add_argument calls define run-config flags (the
+# subcommand-specific parsers — export trees, report, infer paths — are
+# their own commands' surfaces, not Config overrides).
+_FLAG_FUNCTIONS = ("_add_override_flags", "_add_supervise_flags")
+
+
+def _config_fields(mod: Module) -> dict[str, int]:
+    """Field name -> declaration line of the frozen Config dataclass,
+    parsed from the AST (importing config.py would drag in the model zoo)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return {
+                stmt.target.id: stmt.lineno
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            }
+    return {}
+
+
+def _cli_flags(mod: Module) -> list[tuple[str, str, int]]:
+    """(flag, dest, line) for every long-option add_argument in the shared
+    override/supervise flag builders."""
+    flags: list[tuple[str, str, int]] = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name in _FLAG_FUNCTIONS):
+            continue
+        for call in ast.walk(node):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "add_argument"):
+                continue
+            flag = _str_arg(call)
+            if not flag or not flag.startswith("--"):
+                continue
+            dest = None
+            for kw in call.keywords:
+                if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                    dest = kw.value.value
+            if dest is None:
+                dest = flag[2:].replace("-", "_")
+            flags.append((flag, dest, call.lineno))
+    return flags
+
+
+def _override_keys(mod: Module) -> tuple[list[str], int]:
+    """The literal ``keys = [...]`` list inside ``_overrides`` — the dests
+    that flow straight into ``dataclasses.replace``."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_overrides":
+            for stmt in ast.walk(node):
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == "keys"
+                        and isinstance(stmt.value, (ast.List, ast.Tuple))):
+                    return [
+                        e.value for e in stmt.value.elts
+                        if isinstance(e, ast.Constant)
+                    ], stmt.lineno
+    return [], 0
+
+
+@register("config-cli")
+def config_cli_rule(tree: Tree) -> list[Finding]:
+    """CLI flags vs ``Config`` fields, both directions, plus the
+    ``_overrides`` routing list — the three surfaces that historically
+    drift apart (a flag that parses but never lands in the config, a field
+    nobody can set, a stale routing key)."""
+    cfg_mod = tree.module("config.py")
+    cli_mod = tree.module("cli.py")
+    if cfg_mod is None or cli_mod is None:
+        return []  # fixture trees without the real package layout
+    fields = _config_fields(cfg_mod)
+    if not fields:
+        return []
+    findings: list[Finding] = []
+    flags = _cli_flags(cli_mod)
+    dests = {d for _, d, _ in flags}
+    for flag, dest, line in flags:
+        if dest in fields or dest in FLAG_ALIASES:
+            continue
+        findings.append(Finding(
+            "config-cli", "unmapped_flag", cli_mod.path, line,
+            f"CLI flag {flag} (dest {dest!r}) maps to no Config field and "
+            "has no FLAG_ALIASES entry — the override would be dropped "
+            "on the floor",
+        ))
+    keys, keys_line = _override_keys(cli_mod)
+    for key in keys:
+        if key not in fields:
+            findings.append(Finding(
+                "config-cli", "stale_override_key", cli_mod.path, keys_line,
+                f"_overrides routes key {key!r} which is not a Config "
+                "field — dataclasses.replace would raise at runtime",
+            ))
+    reachable = set(dests)
+    for targets in FLAG_ALIASES.values():
+        reachable.update(targets)
+    for field, line in fields.items():
+        if field in reachable:
+            continue
+        if field in CLI_EXEMPT_FIELDS:
+            continue
+        findings.append(Finding(
+            "config-cli", "unreachable_field", cfg_mod.path, line,
+            f"Config field {field!r} is reachable from no CLI flag and "
+            "not exempted in CLI_EXEMPT_FIELDS — either expose it or "
+            "record why it is preset-only",
+        ))
+    for field in sorted(CLI_EXEMPT_FIELDS):
+        if field not in fields:
+            findings.append(Finding(
+                "config-cli", "stale_exemption", cfg_mod.path, 0,
+                f"CLI_EXEMPT_FIELDS lists {field!r} which is no longer a "
+                "Config field — drop the stale entry",
+            ))
+        elif field in reachable:
+            findings.append(Finding(
+                "config-cli", "stale_exemption", cfg_mod.path, 0,
+                f"CLI_EXEMPT_FIELDS lists {field!r} but the field IS "
+                "CLI-reachable — drop the stale entry",
+            ))
+    return findings

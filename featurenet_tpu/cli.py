@@ -116,12 +116,14 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--debug-nans", action="store_true",
                    help="jax_debug_nans: fail fast on the op producing a NaN")
     p.add_argument("--inject-faults", dest="inject_faults",
-                   help="chaos spec 'site[@counter=N],...' (featurenet_tpu"
-                        ".faults): deterministically inject failures — "
-                        "checkpoint_corrupt@save=2, sigterm@step=120, "
-                        "producer_crash@batch=40, sink_enospc@emit=10 … — "
-                        "to exercise the recovery paths; each fault fires "
-                        "once per run (markers in --run-dir)")
+                   help="chaos spec 'site[@counter=N[:every=M]],...' "
+                        "(featurenet_tpu.faults): deterministically inject "
+                        "failures — checkpoint_corrupt@save=2, "
+                        "sigterm@step=120, producer_crash@batch=40, "
+                        "sink_enospc@emit=10 … — to exercise the recovery "
+                        "paths; each fault fires once per run (markers in "
+                        "--run-dir), or once per every=M counter stride "
+                        "for soak testing (per-firing markers)")
 
 
 def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
@@ -368,6 +370,28 @@ def main(argv=None) -> None:
     p_bld.add_argument("--run-dir", dest="run_dir",
                        help="observability directory: record per-class "
                             "ingest spans (see `cli report`)")
+    p_lint = sub.add_parser("lint", allow_abbrev=False,
+                            help="repo-native static analysis "
+                                 "(featurenet_tpu.analysis): enforce the "
+                                 "telemetry, fault-site, host-sync, "
+                                 "timing-hygiene, and config/CLI contracts "
+                                 "over the package's own AST; exits 2 on "
+                                 "findings")
+    p_lint.add_argument("path", nargs="?", default=None,
+                        help="directory (or single file) to lint; default: "
+                             "the installed featurenet_tpu package. A path "
+                             "inside the package lints the whole package "
+                             "(the contracts are package-wide) and narrows "
+                             "the reported findings to that subtree; a "
+                             "path outside is linted as its own tree")
+    p_lint.add_argument("--json", action="store_true", dest="as_json",
+                        help="one JSON object per finding plus a summary "
+                             "record, instead of the text rendering")
+    p_lint.add_argument("--rule", action="append", dest="rules",
+                        metavar="NAME",
+                        help="run only this rule family (repeatable): "
+                             "telemetry, fault-sites, host-sync, hygiene, "
+                             "config-cli")
     p_rep = sub.add_parser("report", allow_abbrev=False,
                            help="analyze a run directory's observability "
                                 "log (featurenet_tpu.obs): step-time "
@@ -426,6 +450,20 @@ def main(argv=None) -> None:
                        help="observability directory: record per-batch "
                             "serving latency spans (see `cli report`)")
     args = parser.parse_args(argv)
+
+    if args.cmd == "lint":
+        # Static analysis of the package itself: stdlib + ast only, no
+        # backend — must run in CI preambles and on bare laptops.
+        from featurenet_tpu.analysis import format_findings, run_lint
+
+        try:
+            findings = run_lint(args.path, rules=args.rules or None)
+        except (ValueError, OSError, SyntaxError) as e:
+            raise SystemExit(f"lint: {e}")
+        print(format_findings(findings, as_json=args.as_json))
+        if findings:
+            raise SystemExit(2)
+        return
 
     if args.cmd == "report":
         # Post-hoc analysis of a finished (or crashed) run: stdlib-only —

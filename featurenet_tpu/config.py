@@ -172,10 +172,12 @@ class Config:
     # spec like "checkpoint_corrupt@save=2,sigterm@step=120" that makes
     # the run fail in a scripted, deterministic way so the recovery paths
     # (checkpoint fallback, preemption resume, supervisor restart, sink
-    # degradation) are *tested* properties, not claims. None (default) =
-    # every injection site is a single attribute check — no step-loop
-    # overhead. One-shot markers live in run_dir, so a supervised run's
-    # respawned children don't re-fire the same fault.
+    # degradation) are *tested* properties, not claims. A
+    # ":every=M" suffix (sigterm@step=100:every=50) re-fires the fault on
+    # every M-counter stride — soak testing. None (default) = every
+    # injection site is a single attribute check — no step-loop overhead.
+    # One-shot (or, with every=, per-firing) markers live in run_dir, so a
+    # supervised run's respawned children don't re-fire the same fault.
     inject_faults: Optional[str] = None
     # Liveness: when set, the Trainer touches this file at every confirmed
     # point of progress (a device readback, an eval, a checkpoint). A

@@ -13,18 +13,28 @@ Spec DSL (comma-separated, one entry per site)::
 
     checkpoint_corrupt@save=2,producer_hang@batch=40,sigterm@step=120
 
-Each entry is ``site[@counter=N]``: the fault fires the first time the
-site calls ``maybe_fail(site, counter=value)`` with ``value >= N``
+Each entry is ``site[@counter=N[:every=M]]``: the fault fires the first
+time the site calls ``maybe_fail(site, counter=value)`` with ``value >= N``
 (counters are site-defined ordinals — the step number, the Nth save, the
 Nth emit; see ``SITES`` — and may stride past N: fused dispatch advances
 the step by k, worker w's tickets go w, w+W, …). A bare ``site`` fires on
-the site's first check. Every fault
+the site's first check. Without ``:every=``, every fault
 fires **once**: in-memory for the process, and — when ``install`` is given
 a ``state_dir`` — once per *run*, via a ``fault_<site>.fired`` marker file
 that respawned children (supervisor restarts re-exec the same argv, so the
 same spec) see and skip. That one-shot-per-run contract is what lets a
 supervised e2e inject a crash and still assert the run completes: attempt
 1 dies, attempt 2 finds the marker and runs clean.
+
+``:every=M`` makes the trigger *repeatable* (soak testing: a run that
+must survive a fault every N steps, not just one): thresholds form the
+arithmetic ladder N, N+M, N+2M, … and the site fires once per rung, at
+the first check whose counter reaches it (several rungs crossed in one
+stride — a fused dispatch jumping k steps — collapse into ONE firing at
+the highest rung crossed, so injection rate never exceeds the check
+rate). The one-shot marker becomes per-firing: ``fault_<site>.fired.<T>``
+records rung ``T``, so a respawned child skips the rungs already fired
+this run but still fires the later ones as its counters reach them.
 
 Zero overhead when off: ``maybe_fail`` with no plan installed is one module
 attribute load and a ``None`` check — no counters, no dict lookups, nothing
@@ -67,10 +77,13 @@ SITES = {
 }
 
 
-def parse_spec(spec: str) -> dict[str, Optional[tuple[str, int]]]:
-    """``"a@k=1,b"`` → ``{"a": ("k", 1), "b": None}``; validates sites and
-    counter names so a typo fails the run at config time, not silently."""
-    out: dict[str, Optional[tuple[str, int]]] = {}
+def parse_spec(spec: str) -> dict[str, Optional[tuple]]:
+    """``"a@k=1,b,c@k=5:every=2"`` →
+    ``{"a": ("k", 1), "b": None, "c": ("k", 5, 2)}`` — a 2-tuple is a
+    one-shot threshold, a 3-tuple adds the re-fire stride. Validates
+    sites, counter names, and stride syntax so a typo fails the run at
+    config time, not silently."""
+    out: dict[str, Optional[tuple]] = {}
     for raw in spec.split(","):
         entry = raw.strip()
         if not entry:
@@ -86,10 +99,12 @@ def parse_spec(spec: str) -> dict[str, Optional[tuple[str, int]]]:
         if not sep:
             out[site] = None
             continue
+        trigger, colon, stride = trigger.partition(":")
         name, eq, value = trigger.partition("=")
         if not eq or not name:
             raise ValueError(
-                f"malformed trigger {entry!r}: expected site@counter=N"
+                f"malformed trigger {entry!r}: expected "
+                "site@counter=N[:every=M]"
             )
         if name != SITES[site]:
             raise ValueError(
@@ -102,7 +117,27 @@ def parse_spec(spec: str) -> dict[str, Optional[tuple[str, int]]]:
             raise ValueError(
                 f"trigger value in {entry!r} must be an integer"
             ) from None
-        out[site] = (name, n)
+        if not colon:
+            out[site] = (name, n)
+            continue
+        skey, seq, svalue = stride.partition("=")
+        if skey != "every" or not seq:
+            raise ValueError(
+                f"malformed stride {entry!r}: expected "
+                "site@counter=N:every=M"
+            )
+        try:
+            every = int(svalue)
+        except ValueError:
+            raise ValueError(
+                f"every value in {entry!r} must be an integer"
+            ) from None
+        if every <= 0:
+            raise ValueError(
+                f"every in {entry!r} must be positive (a zero/negative "
+                "stride would re-fire on every check)"
+            )
+        out[site] = (name, n, every)
     if not out:
         raise ValueError(f"empty fault spec {spec!r}")
     return out
@@ -124,19 +159,31 @@ class FaultPlan:
             self.sites = {k: v for k, v in self.sites.items() if k in only}
         self.state_dir = os.path.abspath(state_dir) if state_dir else None
         self._fired: set[str] = set()
+        # Repeatable sites: highest rung fired so far (per site), so a
+        # counter that runs backwards (a restarted worker's tickets) can
+        # never re-fire a rung below one already taken.
+        self._floor: dict[str, int] = {}
         self._lock = threading.Lock()
 
-    def _marker(self, site: str) -> Optional[str]:
+    def _marker(self, site: str, rung: Optional[int] = None) -> Optional[str]:
+        """One-shot sites keep the legacy ``fault_<site>.fired`` name (old
+        run dirs and tests stay valid); repeatable sites get one marker per
+        rung — ``fault_<site>.fired.<rung>`` — so a respawned child skips
+        exactly the firings this run already took, not the whole ladder."""
         if self.state_dir is None:
             return None
-        return os.path.join(self.state_dir, f"fault_{site}.fired")
+        name = (f"fault_{site}.fired" if rung is None
+                else f"fault_{site}.fired.{rung}")
+        return os.path.join(self.state_dir, name)
 
     def check(self, site: str, counter: dict) -> bool:
         entry = self.sites.get(site, False)
-        if entry is False or site in self._fired:
+        if entry is False:
             return False
+        rung: Optional[int] = None  # None = one-shot (bare or N-threshold)
         if entry is not None:
-            name, value = entry
+            name, value = entry[0], entry[1]
+            every = entry[2] if len(entry) > 2 else None
             got = counter.get(name)
             # Threshold crossing, not equality: counters may stride past N
             # (a fused-dispatch loop advances step by k; worker w's prefetch
@@ -148,26 +195,42 @@ class FaultPlan:
             # run_dir/checkpoint_dir.
             if got is None or got < value:
                 return False
+            if every is not None:
+                # Repeatable ladder N, N+M, …: fire at the highest rung
+                # this counter has crossed — several rungs crossed in one
+                # stride collapse into one firing.
+                rung = value + ((got - value) // every) * every
+        key = site if rung is None else f"{site}@{rung}"
+        if key in self._fired:
+            return False
         with self._lock:
-            if site in self._fired:
+            if key in self._fired:
                 return False
-            marker = self._marker(site)
+            if rung is not None and self._floor.get(site, rung - 1) >= rung:
+                return False
+            marker = self._marker(site, rung)
             if marker is not None and os.path.exists(marker):
                 # Fired by an earlier process of this run (a respawned
-                # child re-executes the same argv/spec) — one-shot holds
-                # across restarts.
-                self._fired.add(site)
+                # child re-executes the same argv/spec) — the one-shot /
+                # per-rung contract holds across restarts.
+                self._fired.add(key)
+                if rung is not None:
+                    self._floor[site] = rung
                 return False
-            self._fired.add(site)
+            self._fired.add(key)
+            if rung is not None:
+                self._floor[site] = rung
             if marker is not None:
                 os.makedirs(self.state_dir, exist_ok=True)
                 with open(marker, "w") as fh:
                     fh.write(json.dumps({"site": site, "pid": os.getpid(),
-                                         "counter": counter}))
+                                         "rung": rung, "counter": counter}))
         # stderr, never obs.warn: sink_enospc fires *inside* EventSink.emit
         # and an obs re-entry would recurse.
-        print(json.dumps({"fault_injected": site, "pid": os.getpid(),
-                          **counter}), file=sys.stderr)
+        record = {"fault_injected": site, "pid": os.getpid(), **counter}
+        if rung is not None:
+            record["rung"] = rung
+        print(json.dumps(record), file=sys.stderr)
         return True
 
 

@@ -440,6 +440,24 @@ def _fmt_s(v: float) -> str:
     return f"{v:.3f}s" if v < 100 else f"{v:.1f}s"
 
 
+def _skew_parts(skew: dict) -> list:
+    """Human fragments of the cross-host skew stats — ONE renderer shared
+    by the report body and the live tail's header, so the two views can
+    never drift on formulas or labels."""
+    parts = []
+    if skew.get("loop_start_skew_s") is not None:
+        parts.append(f"loop-start skew {skew['loop_start_skew_s']}s")
+    if skew.get("wall_s_skew") is not None:
+        parts.append(f"wall skew {skew['wall_s_skew']}s")
+    dwf = skew.get("data_wait_fraction")
+    if dwf:
+        parts.append(
+            f"data-wait spread {dwf['spread'] * 100:.1f}pp "
+            f"({dwf['min'] * 100:.1f}%–{dwf['max'] * 100:.1f}%)"
+        )
+    return parts
+
+
 def format_report(rep: dict) -> str:
     """Human-readable rendering (the CLI's default output; --json gives
     the raw dict)."""
@@ -500,17 +518,7 @@ def format_report(rep: dict) -> str:
                 + f"  {h.get('warnings', 0):>4}"
             )
         skew = rep.get("host_skew") or {}
-        parts = []
-        if "loop_start_skew_s" in skew:
-            parts.append(f"loop start {skew['loop_start_skew_s']}s")
-        if "wall_s_skew" in skew:
-            parts.append(f"wall {skew['wall_s_skew']}s")
-        dwf = skew.get("data_wait_fraction")
-        if dwf:
-            parts.append(
-                f"data_wait {dwf['min'] * 100:.1f}%–{dwf['max'] * 100:.1f}% "
-                f"(spread {dwf['spread'] * 100:.1f}pp)"
-            )
+        parts = _skew_parts(skew)
         if parts:
             lines.append("host skew: " + ", ".join(parts))
         if skew.get("step_mismatch"):
@@ -642,6 +650,22 @@ def is_terminal_event(e: dict) -> bool:
     )
 
 
+def follow_header(rep: dict, run_dir: str) -> str:
+    """The live tail's one-line banner: where the mesh stands *right now* —
+    host count, per-host loop-start skew, and the cross-host data-wait
+    spread (a fat spread on a lockstep mesh is free throughput, worth
+    noticing while the run is still hot, not in the post-mortem). Falls
+    back to a single-host marker when only one stream exists."""
+    parts = [f"following {run_dir}"]
+    hosts = rep.get("hosts")
+    if hosts:
+        parts.append(f"{len(hosts)} hosts")
+        parts.extend(_skew_parts(rep.get("host_skew") or {}))
+    else:
+        parts.append("single host")
+    return "== " + " | ".join(parts)
+
+
 def follow_report(
     run_dir: str,
     interval: float = 3.0,
@@ -669,7 +693,8 @@ def follow_report(
             rep = build_report(events, manifest, bad_lines=tail.bad)
             prefix = "\x1b[2J\x1b[H" if clear else ""
             out(
-                prefix + format_report(rep)
+                prefix + follow_header(rep, run_dir) + "\n"
+                + format_report(rep)
                 + f"\n-- following {run_dir} ({len(events)} events, "
                 f"re-render every {interval:g}s; Ctrl-C to stop)"
             )

@@ -458,12 +458,14 @@ def aggregate_eval(metric_list: list[dict]) -> dict[str, float]:
     total = {}
     for m in metric_list:
         for k, v in m.items():
+            # lint: allow-host-sync(eval epilogue: exact host aggregation)
             total[k] = total.get(k, 0) + np.asarray(v)
     out = {
         "accuracy": float(total["correct"] / total["count"]),
         "loss": float(total["loss_sum"] / total["count"]),
     }
     if "confusion" in total:
+        # lint: allow-host-sync(already host-resident after the sum above)
         conf = np.asarray(total["confusion"])
         row = conf.sum(axis=1)
         per_class = np.where(row > 0, np.diag(conf) / np.maximum(row, 1), 0.0)

@@ -258,6 +258,7 @@ def supervise(
                 # clock restarts from now.
                 touch_heartbeat(heartbeat_file)
                 mtime = base_mtime = os.path.getmtime(heartbeat_file)
+            # lint: allow-wall-clock(file mtimes are epoch-based)
             age = time.time() - mtime
             if not first_beat_seen:
                 if mtime > base_mtime:
@@ -270,6 +271,7 @@ def supervise(
                 # laggy shared-filesystem mtime) and a SIGKILL on a live,
                 # progressing child costs a full restart for nothing.
                 try:
+                    # lint: allow-wall-clock(file mtimes are epoch-based)
                     age = time.time() - os.path.getmtime(heartbeat_file)
                 except OSError:
                     pass

@@ -59,8 +59,11 @@ class MetricLogger:
         self.history.append(record)
         print(json.dumps(record), file=self.stream, flush=True)
         # Mirror into the run-scoped event log (no-op without a run_dir):
-        # one artifact then holds metrics AND timing/liveness events.
-        obs.emit("metrics", **record)
+        # one artifact then holds metrics AND timing/liveness events. The
+        # event's required `kind` field is passed as a literal key — the
+        # telemetry lint (analysis/rules.py) can't see inside a splat.
+        obs.emit("metrics", kind=prefix,
+                 **{k: v for k, v in record.items() if k != "kind"})
         if self._tb is not None:
             scalars = {
                 f"{prefix}/{k}": v

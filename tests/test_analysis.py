@@ -1,0 +1,509 @@
+"""Static-analysis contract linter (featurenet_tpu.analysis).
+
+Two layers of coverage:
+
+1. **Fixture snippets** per rule family: each violation class is caught
+   with the offending file:line, each suppression is honored, and a clean
+   snippet passes — the linter's own behavioral contract.
+2. **Self-clean tier-1 gate**: the installed package lints to zero
+   findings. This is the test that makes the contracts *enforced*:
+   deleting a ``maybe_fail`` call site surfaces as ``dead_site``, removing
+   a required field from an emit surfaces as ``missing_fields``, a new
+   Config field with no flag and no exemption surfaces as
+   ``unreachable_field`` — all as a red test, not a silent drift.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from featurenet_tpu.analysis import (
+    format_findings,
+    package_root,
+    run_lint,
+)
+
+
+def _write(root, relpath: str, source: str) -> str:
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(textwrap.dedent(source))
+    return path
+
+
+def _checks(findings, rule=None):
+    return [f.check for f in findings if rule is None or f.rule == rule]
+
+
+# --- rule: telemetry ---------------------------------------------------------
+
+def _clean_telemetry_source() -> str:
+    """One emit per known kind, each carrying its required fields as
+    literal keys — the telemetry rule's zero-finding fixture."""
+    from featurenet_tpu.obs.report import (
+        KNOWN_EVENT_KINDS,
+        REQUIRED_EVENT_FIELDS,
+    )
+
+    lines = ["from featurenet_tpu import obs", ""]
+    for kind in sorted(KNOWN_EVENT_KINDS):
+        kw = ", ".join(
+            f"{f}=1" for f in REQUIRED_EVENT_FIELDS.get(kind, ())
+        )
+        lines.append(
+            f"obs.emit({kind!r}{', ' + kw if kw else ''})"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def test_telemetry_clean_fixture_passes(tmp_path):
+    _write(tmp_path, "sites.py", _clean_telemetry_source())
+    assert run_lint(str(tmp_path), rules=["telemetry"]) == []
+
+
+def test_telemetry_unknown_kind_caught_with_location(tmp_path):
+    path = _write(tmp_path, "sites.py", _clean_telemetry_source()
+                  + 'obs.emit("totally_new_kind", x=1)\n')
+    findings = run_lint(str(tmp_path), rules=["telemetry"])
+    assert [f.check for f in findings] == ["unknown_kind"]
+    assert findings[0].path == path
+    assert findings[0].line == len(open(path).read().splitlines())
+    assert "totally_new_kind" in findings[0].msg
+
+
+def test_telemetry_missing_required_field_and_splat_not_enough(tmp_path):
+    _write(tmp_path, "sites.py", _clean_telemetry_source()
+           + 'obs.emit("gauge", name="q")\n'            # missing value
+           + 'fields = {"name": "a", "dur_s": 1}\n'
+           + 'obs.emit("span", **fields)\n')            # splat hides keys
+    findings = run_lint(str(tmp_path), rules=["telemetry"])
+    assert _checks(findings) == ["missing_fields", "missing_fields"]
+    assert "['value']" in findings[0].msg
+    assert "splat" in findings[1].msg
+
+
+def test_telemetry_warn_positionals_and_warnings_module_exempt(tmp_path):
+    _write(tmp_path, "sites.py", _clean_telemetry_source()
+           + 'import warnings\n'
+           + 'obs.warn("mesh_warning", "degraded", extra=1)\n'  # name, msg
+           + 'warnings.warn("stdlib warning, different contract")\n'
+           + 'obs.warn("half_warning")\n')                      # msg missing
+    findings = run_lint(str(tmp_path), rules=["telemetry"])
+    assert _checks(findings) == ["missing_fields"]
+    assert "warn(...)" in findings[0].msg
+
+
+def test_telemetry_dead_schema_when_kind_has_no_site(tmp_path):
+    # A tree that emits only heartbeats: every other kind is dead schema.
+    _write(tmp_path, "sites.py", """\
+        from featurenet_tpu import obs
+        obs.emit("heartbeat", age_s=1.0)
+    """)
+    findings = run_lint(str(tmp_path), rules=["telemetry"])
+    assert set(_checks(findings)) == {"dead_schema"}
+    assert any("'preempt'" in f.msg for f in findings)
+
+
+# --- rule: fault-sites -------------------------------------------------------
+
+def _clean_fault_source() -> str:
+    from featurenet_tpu.faults import SITES
+
+    lines = ["from featurenet_tpu import faults", ""]
+    for site, counter in sorted(SITES.items()):
+        lines.append(f"faults.maybe_fail({site!r}, {counter}=1)")
+    return "\n".join(lines) + "\n"
+
+
+def test_fault_sites_clean_fixture_passes(tmp_path):
+    _write(tmp_path, "sites.py", _clean_fault_source())
+    assert run_lint(str(tmp_path), rules=["fault-sites"]) == []
+
+
+def test_fault_sites_unknown_site_caught(tmp_path):
+    path = _write(tmp_path, "sites.py", _clean_fault_source()
+                  + 'faults.maybe_fail("tyop_site", step=1)\n')
+    findings = run_lint(str(tmp_path), rules=["fault-sites"])
+    assert [f.check for f in findings] == ["unknown_site"]
+    assert findings[0].path == path and findings[0].line > 0
+
+
+def test_fault_sites_wrong_and_missing_counter(tmp_path):
+    _write(tmp_path, "sites.py", _clean_fault_source()
+           + 'faults.maybe_fail("sigterm", save=3)\n')
+    findings = run_lint(str(tmp_path), rules=["fault-sites"])
+    assert set(_checks(findings)) == {"missing_counter", "wrong_counter"}
+
+
+def test_fault_sites_dead_site_when_call_site_deleted(tmp_path):
+    """The acceptance scenario: delete one maybe_fail call site and the
+    lint (and therefore the tier-1 self-clean test) goes red."""
+    source = _clean_fault_source().replace(
+        "faults.maybe_fail('sigterm', step=1)\n", ""
+    )
+    assert "sigterm" not in source
+    _write(tmp_path, "sites.py", source)
+    findings = run_lint(str(tmp_path), rules=["fault-sites"])
+    assert [f.check for f in findings] == ["dead_site"]
+    assert "'sigterm'" in findings[0].msg
+
+
+# --- rule: host-sync ---------------------------------------------------------
+
+_HOT_SNIPPET = """\
+    import jax
+    import numpy as np
+
+    def hot(metrics, stats):
+        a = metrics.item()
+        b = jax.device_get(stats)
+        c = jax.block_until_ready(metrics)
+        d = np.asarray(metrics)
+        return a, b, c, d
+"""
+
+
+def test_host_sync_flags_each_construct_in_hot_modules(tmp_path):
+    path = _write(tmp_path, "train/loop.py", _HOT_SNIPPET)
+    findings = run_lint(str(tmp_path), rules=["host-sync"])
+    assert [f.check for f in findings] == ["host_sync"] * 4
+    assert [f.line for f in findings] == [5, 6, 7, 8]
+    assert all(f.path == path for f in findings)
+    texts = " | ".join(f.msg for f in findings)
+    for construct in (".item()", "jax.device_get", "block_until_ready",
+                      "np.asarray"):
+        assert construct in texts
+
+
+def test_host_sync_only_designated_modules(tmp_path):
+    _write(tmp_path, "data/loader.py", _HOT_SNIPPET)
+    assert run_lint(str(tmp_path), rules=["host-sync"]) == []
+
+
+def test_host_sync_suppression_same_line_and_line_above(tmp_path):
+    _write(tmp_path, "infer.py", """\
+        import numpy as np
+
+        def serve(dev):
+            y = np.asarray(dev)  # lint: allow-host-sync(readback is latency)
+            # lint: allow-host-sync(second deliberate sync)
+            z = np.asarray(dev)
+            return y, z
+    """)
+    assert run_lint(str(tmp_path), rules=["host-sync"]) == []
+
+
+def test_host_sync_suppression_needs_reason(tmp_path):
+    # An empty-parens suppression doesn't parse as a suppression at all.
+    _write(tmp_path, "infer.py", """\
+        import numpy as np
+
+        def serve(dev):
+            return np.asarray(dev)  # lint: allow-host-sync()
+    """)
+    findings = run_lint(str(tmp_path), rules=["host-sync"])
+    assert [f.check for f in findings] == ["host_sync"]
+
+
+# --- rule: hygiene -----------------------------------------------------------
+
+def test_hygiene_wall_clock_direct_and_via_variable(tmp_path):
+    _write(tmp_path, "timers.py", """\
+        import time
+
+        def ages(t0):
+            direct = time.time() - t0
+            now = time.time()
+            indirect = now - t0
+            fine = time.perf_counter() - t0
+            stamp = time.time()  # no arithmetic: just a stamp
+            return direct, indirect, fine, stamp
+    """)
+    findings = run_lint(str(tmp_path), rules=["hygiene"])
+    assert [f.check for f in findings] == ["wall_clock_arith"] * 2
+    assert [f.line for f in findings] == [4, 6]
+
+
+def test_telemetry_foreign_warn_apis_exempt(tmp_path):
+    """Only obs.warn / bare warn are under the telemetry contract — a
+    stdlib logger's .warn must not be forced into the warning schema."""
+    _write(tmp_path, "sites.py", _clean_telemetry_source()
+           + 'import logging\n'
+           + 'log = logging.getLogger(__name__)\n'
+           + 'log.warn("retrying")\n')
+    assert run_lint(str(tmp_path), rules=["telemetry"]) == []
+
+
+def test_hygiene_wall_clock_tracking_is_position_aware(tmp_path):
+    """A name rebound to perf_counter after an earlier epoch stamp must
+    not taint later subtraction — and the reverse order must."""
+    _write(tmp_path, "timers.py", """\
+        import time
+
+        def fine(t0, manifest):
+            now = time.time()
+            manifest["stamp"] = now
+            now = time.perf_counter()
+            return now - t0
+
+        def bad(t0):
+            now = time.perf_counter()
+            now = time.time()
+            return now - t0
+    """)
+    findings = run_lint(str(tmp_path), rules=["hygiene"])
+    assert [(f.check, f.line) for f in findings] == [
+        ("wall_clock_arith", 12),
+    ]
+
+
+def test_hygiene_wall_clock_suppression(tmp_path):
+    _write(tmp_path, "timers.py", """\
+        import os
+        import time
+
+        def mtime_age(path):
+            # lint: allow-wall-clock(file mtimes are epoch-based)
+            return time.time() - os.path.getmtime(path)
+    """)
+    assert run_lint(str(tmp_path), rules=["hygiene"]) == []
+
+
+def test_hygiene_bare_except_and_thread_daemon(tmp_path):
+    _write(tmp_path, "workers.py", """\
+        import threading
+
+        def spawn(fn):
+            try:
+                t = threading.Thread(target=fn)
+            except:
+                t = None
+            good = threading.Thread(target=fn, daemon=True)
+            return t, good
+    """)
+    findings = run_lint(str(tmp_path), rules=["hygiene"])
+    assert sorted(_checks(findings)) == ["bare_except", "thread_daemon"]
+
+
+# --- rule: config-cli --------------------------------------------------------
+
+def _fixture_config(extra_fields: str = "") -> str:
+    """A Config class carrying every CLI_EXEMPT_FIELDS entry (so the
+    staleness check stays quiet) plus the test's own fields."""
+    from featurenet_tpu.analysis.rules import CLI_EXEMPT_FIELDS
+
+    body = "\n".join(f"    {f}: int = 0" for f in sorted(CLI_EXEMPT_FIELDS))
+    return (
+        "class Config:\n"
+        "    resolution: int = 64\n" + body + "\n" + extra_fields
+    )
+
+
+_FIXTURE_CLI = """\
+    def _add_override_flags(p):
+        p.add_argument("--resolution", type=int)
+    {extra_flag}
+
+    def _overrides(args):
+        keys = [{keys}]
+        return keys
+"""
+
+
+def _write_config_cli(tmp_path, extra_fields="", extra_flag="",
+                      keys="'resolution'"):
+    _write(tmp_path, "config.py", _fixture_config(extra_fields))
+    _write(tmp_path, "cli.py",
+           _FIXTURE_CLI.format(extra_flag=extra_flag, keys=keys))
+
+
+def test_config_cli_clean_fixture_passes(tmp_path):
+    _write_config_cli(tmp_path)
+    assert run_lint(str(tmp_path), rules=["config-cli"]) == []
+
+
+def test_config_cli_unmapped_flag(tmp_path):
+    _write_config_cli(
+        tmp_path, extra_flag='    p.add_argument("--warp-speed", type=int)'
+    )
+    findings = run_lint(str(tmp_path), rules=["config-cli"])
+    assert [f.check for f in findings] == ["unmapped_flag"]
+    assert "--warp-speed" in findings[0].msg and findings[0].line > 0
+
+
+def test_config_cli_stale_override_key_and_unreachable_field(tmp_path):
+    _write_config_cli(
+        tmp_path,
+        extra_fields="    mystery_field: int = 1\n",
+        keys="'resolution', 'ghost_key'",
+    )
+    findings = run_lint(str(tmp_path), rules=["config-cli"])
+    assert sorted(_checks(findings)) == [
+        "stale_override_key", "unreachable_field",
+    ]
+    msgs = " | ".join(f.msg for f in findings)
+    assert "ghost_key" in msgs and "mystery_field" in msgs
+
+
+def test_config_cli_stale_exemption_when_field_reachable(tmp_path):
+    # log_every is whitelisted as CLI-unreachable; growing it a flag must
+    # flag the now-stale exemption.
+    _write_config_cli(
+        tmp_path, extra_flag='    p.add_argument("--log-every", type=int)'
+    )
+    findings = run_lint(str(tmp_path), rules=["config-cli"])
+    assert [f.check for f in findings] == ["stale_exemption"]
+    assert "log_every" in findings[0].msg
+
+
+# --- output formats / CLI surface --------------------------------------------
+
+def test_text_and_json_output_carry_file_and_line(tmp_path):
+    _write(tmp_path, "train/loop.py", "x = 1\ny = x.item()\n")
+    findings = run_lint(str(tmp_path), rules=["host-sync"])
+    text = format_findings(findings)
+    assert "train/loop.py:2" in text.replace(os.sep, "/")
+    assert "finding(s)" in text
+    as_json = format_findings(findings, as_json=True).splitlines()
+    rows = [json.loads(line) for line in as_json]
+    assert rows[0]["line"] == 2 and rows[0]["check"] == "host_sync"
+    assert rows[-1] == {"lint": "fail", "findings": 1}
+    clean = format_findings([], as_json=True)
+    assert json.loads(clean) == {"lint": "ok", "findings": 0}
+
+
+def test_cli_lint_exit_codes_and_json(tmp_path, capsys):
+    from featurenet_tpu.cli import main
+
+    _write(tmp_path, "train/steps.py", "import numpy as np\n"
+                                       "z = np.asarray(object())\n")
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", str(tmp_path), "--json", "--rule", "host-sync"])
+    assert exc.value.code == 2
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows[0]["rule"] == "host-sync" and rows[0]["line"] == 2
+    assert rows[-1]["findings"] == 1
+    # Rule filter: the same tree is clean under an unrelated rule.
+    main(["lint", str(tmp_path), "--rule", "hygiene"])
+    assert "lint: ok" in capsys.readouterr().out
+    # Unknown rule name: a hard error, not a silently-empty lint.
+    with pytest.raises(SystemExit, match="unknown lint rule"):
+        main(["lint", str(tmp_path), "--rule", "nope"])
+
+
+def test_lint_subpath_of_package_keeps_contract_semantics(tmp_path,
+                                                          monkeypatch):
+    """Linting a path INSIDE the package must behave like the package-wide
+    lint narrowed to that subtree: the hot-path rule still keys on the
+    package-rooted relpath (no false negative on `cli lint train/loop.py`),
+    and package-level findings (a dead fault site) still surface."""
+    from featurenet_tpu.analysis import lint as lint_mod
+
+    _write(tmp_path, "train/loop.py",
+           "import numpy as np\nz = np.asarray(object())\n")
+    _write(tmp_path, "data/loader.py", "x = 1\n")
+    monkeypatch.setattr(lint_mod, "package_root", lambda: str(tmp_path))
+    # Single-file target: relpath stays 'train/loop.py', so host-sync fires.
+    findings = run_lint(str(tmp_path / "train" / "loop.py"),
+                        rules=["host-sync"])
+    assert [f.check for f in findings] == ["host_sync"]
+    # Sibling subtree target: the loop.py finding is outside it — narrowed
+    # away; package-level (line 0) findings survive the narrowing.
+    assert run_lint(str(tmp_path / "data"), rules=["host-sync"]) == []
+    dead = run_lint(str(tmp_path / "data"), rules=["fault-sites"])
+    assert dead and all(f.check == "dead_site" and f.line == 0
+                        for f in dead)
+
+
+def test_lint_missing_or_empty_target_fails_loudly(tmp_path):
+    """A typo'd CI path must error, not lint clean forever."""
+    from featurenet_tpu.cli import main
+
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        run_lint(str(tmp_path / "nope"))
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(FileNotFoundError, match="no .py files"):
+        run_lint(str(tmp_path / "empty"))
+    with pytest.raises(SystemExit, match="does not exist") as exc:
+        main(["lint", str(tmp_path / "nope")])
+    assert exc.value.code != 0
+
+
+def test_rule_registry_populated_at_import():
+    from featurenet_tpu.analysis import RULE_NAMES
+    from featurenet_tpu.analysis.lint import RULES
+
+    assert set(RULE_NAMES) == {
+        "telemetry", "fault-sites", "host-sync", "hygiene", "config-cli",
+    }
+    assert set(RULES) == set(RULE_NAMES)
+
+
+def test_lint_repo_checkout_root_reroots_to_package():
+    """`cli lint .` from a checkout: the package lives UNDER the target —
+    re-rooted to the package, so path-keyed rules stay armed and the
+    tests tree's deliberate fixture violations don't read as findings."""
+    repo_root = os.path.dirname(package_root())
+    findings = run_lint(repo_root)
+    assert findings == [], "\n" + format_findings(findings)
+
+
+def test_lint_subpath_of_real_package_has_no_false_positives():
+    """`cli lint featurenet_tpu/train` on the clean repo must exit clean —
+    the cross-file existence checks (dead_schema/dead_site, config-cli)
+    see the whole package, not the narrowed subtree."""
+    sub = os.path.join(package_root(), "train")
+    findings = run_lint(sub)
+    assert findings == [], "\n" + format_findings(findings)
+
+
+def test_bench_preamble_fails_round_on_contract_violation(monkeypatch,
+                                                          capsys):
+    """bench.py lints before measuring: a contract violation ends the
+    round with a structured record (same self-policing shape as the gate
+    check), never a number built on a broken invariant."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    from featurenet_tpu.analysis.lint import Finding
+
+    bad = Finding("fault-sites", "dead_site", "faults.py", 0,
+                  "declared site with no call site")
+    monkeypatch.setattr("featurenet_tpu.analysis.run_lint",
+                        lambda *a, **k: [bad])
+    bench.main()
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["skipped"] is True
+    assert row["reason"] == "contract_violation"
+    assert row["bench_schema"] == 2
+    assert row["lint"]["findings"] == 1
+    assert "fault-sites/dead_site" in row["lint"]["first"]
+
+
+# --- the tier-1 gate: the package itself is clean ----------------------------
+
+def test_package_self_clean():
+    """THE enforcement test: the installed package has zero findings.
+
+    This is what turns the contracts into invariants — deleting one
+    ``maybe_fail`` call site (``dead_site``), dropping an emit's required
+    field (``missing_fields``), adding an unannotated hot-loop host sync
+    (``host_sync``), or growing Config a field no flag reaches
+    (``unreachable_field``) all land here as a red test with file:line.
+    """
+    findings = run_lint(package_root())
+    assert findings == [], "\n" + format_findings(findings)
+
+
+def test_package_self_clean_via_cli(capsys):
+    from featurenet_tpu.cli import main
+
+    main(["lint"])  # returns (exit 0) — raises SystemExit(2) on findings
+    assert "lint: ok" in capsys.readouterr().out

@@ -95,6 +95,47 @@ def test_marker_makes_faults_one_shot_per_run(tmp_path):
     assert not faults.maybe_fail("producer_crash", batch=1)
 
 
+def test_repeatable_trigger_parse_and_errors():
+    """Satellite (soak testing): ``site@counter=N:every=M`` re-fires on the
+    threshold ladder N, N+M, …; the grammar fails loudly on typos."""
+    parsed = faults.parse_spec("sigterm@step=100:every=50")
+    assert parsed["sigterm"] == ("step", 100, 50)
+    with pytest.raises(ValueError, match="expected site@counter=N:every=M"):
+        faults.parse_spec("sigterm@step=100:evry=50")
+    with pytest.raises(ValueError, match="must be an integer"):
+        faults.parse_spec("sigterm@step=100:every=soon")
+    with pytest.raises(ValueError, match="must be positive"):
+        faults.parse_spec("sigterm@step=100:every=0")
+
+
+def test_repeatable_trigger_refires_on_stride():
+    faults.install("sigterm@step=2:every=3")
+    fired = [s for s in range(1, 10) if faults.maybe_fail("sigterm", step=s)]
+    assert fired == [2, 5, 8]  # the ladder N, N+M, N+2M
+    # Several rungs crossed in one stride (fused dispatch jumping k steps)
+    # collapse into ONE firing, at the highest rung crossed; a counter that
+    # then runs backwards never re-fires a lower rung.
+    faults.install("sigterm@step=2:every=3")
+    assert faults.maybe_fail("sigterm", step=20)
+    assert not faults.maybe_fail("sigterm", step=20)
+    assert not faults.maybe_fail("sigterm", step=5)
+    assert faults.maybe_fail("sigterm", step=23)
+
+
+def test_repeatable_trigger_markers_are_per_firing(tmp_path):
+    """A respawned child (same argv, same spec, same run dir) skips the
+    rungs this run already fired but still fires the later ones — the
+    property that makes a soak spec survive supervisor restarts."""
+    d = str(tmp_path)
+    faults.install("sigterm@step=2:every=3", state_dir=d)
+    assert faults.maybe_fail("sigterm", step=2)
+    assert os.path.exists(tmp_path / "fault_sigterm.fired.2")
+    faults.install("sigterm@step=2:every=3", state_dir=d)  # "new process"
+    assert not faults.maybe_fail("sigterm", step=2)  # rung 2 already taken
+    assert faults.maybe_fail("sigterm", step=5)      # rung 5 still live
+    assert os.path.exists(tmp_path / "fault_sigterm.fired.5")
+
+
 def test_config_validates_inject_spec():
     from featurenet_tpu.config import get_config
 

@@ -716,6 +716,41 @@ def test_follow_report_renders_and_exits_on_run_end(tmp_path):
                   clock=lambda s: None, max_polls=2, clear=False)
 
 
+def test_follow_header_surfaces_skew_and_data_wait_spread(tmp_path):
+    """Satellite (ROADMAP obs-next): the live tail's header line carries
+    the per-host loop-start skew and cross-host data-wait spread — the
+    lockstep-mesh health signals — while a single-host run says so."""
+    from featurenet_tpu.obs.report import build_report, follow_header
+
+    # Single host: no skew to report, header says single host.
+    single = build_report(_host_events(100.0, 0.0, 0.5))
+    head = follow_header(single, "rd")
+    assert head.startswith("==") and "single host" in head
+
+    run_dir = str(tmp_path)
+    t0 = 1000.0
+    _write_stream(run_dir, "events.jsonl", _host_events(t0, 0.0, 0.5))
+    _write_stream(run_dir, "events.1.jsonl", _host_events(t0, 0.2, 1.0))
+    _write_stream(run_dir, "events.2.jsonl", _host_events(t0, 0.4, 0.25))
+    from featurenet_tpu.obs.report import follow_report, load_events
+
+    events, _ = load_events(run_dir)
+    rep = build_report(events)
+    head = follow_header(rep, run_dir)
+    assert "3 hosts" in head
+    assert "loop-start skew 0.4s" in head
+    # data_wait fractions 12.5%–50% => spread 37.5pp.
+    assert "data-wait spread 37.5pp (12.5%–50.0%)" in head
+
+    # And the live tail actually renders it as the first line.
+    outputs: list = []
+    follow_report(run_dir, interval=0.01, out=outputs.append,
+                  clock=lambda s: None, max_polls=1, clear=False)
+    first_line = outputs[0].splitlines()[0]
+    assert "loop-start skew" in first_line
+    assert "data-wait spread" in first_line
+
+
 def test_gates_pass_fail_and_tolerance_edge():
     from featurenet_tpu.obs import gates
 
