@@ -49,6 +49,40 @@ REPEATS = 5
 # is refreshed with this round's numbers.
 GATE_BASELINE = "BENCH_baseline.json"
 GATE_TOLERANCE = 0.15  # slope spread through the tunnel runs ~3-7%
+# The spread gates (measurement QUALITY, not performance) get an absolute
+# slack on top: a 3.8% → 7% spread is an honest noisy session, not a
+# regression — but a blown-up spread (a contaminated session quoting a
+# lucky draw) should still fail the pin.
+SPREAD_TOLERANCE_ABS = 5.0
+
+
+def _window_gate_fields(run_dir: str) -> dict:
+    """Live-SLO window percentiles of the e2e row, as flat gate-summary
+    fields. The plain (host-streamed) e2e measurement runs with an
+    ambient run dir (see _measure_round), so the Trainer's own dispatch
+    path feeds the rolling windows; the LAST summary per metric is the
+    sustained steady state. Empty dict when the run produced no windows —
+    the gate keys simply stay absent, like the e2e block on a cache-less
+    round."""
+    try:
+        from featurenet_tpu.obs.report import load_events
+
+        events, _ = load_events(run_dir)
+    except (OSError, FileNotFoundError):
+        return {}
+    last: dict = {}
+    for e in events:
+        if e.get("ev") == "window_summary" and e.get("metric"):
+            last[e["metric"]] = e
+    out = {}
+    dw = last.get("data_wait_ms")
+    if dw:
+        out["window_data_wait_p50_ms"] = dw.get("p50")
+        out["window_data_wait_p99_ms"] = dw.get("p99")
+    qd = last.get("queue_depth")
+    if qd:
+        out["window_queue_depth_p50"] = qd.get("p50")
+    return out
 
 
 def _probe_backend() -> tuple[str, str | None]:
@@ -190,13 +224,33 @@ def _measure_round(platform: str) -> dict:
     serving = measure_inference(cfg, repeats=REPEATS)
     e2e = {}
     if os.path.isdir(E2E_CACHE):
+        import tempfile
+
+        from featurenet_tpu import obs
+        from featurenet_tpu.obs import windows as obs_windows
+
         kw = dict(data_cache=E2E_CACHE, data_workers=1,
                   checkpoint_dir=None, heartbeat_file=None)
         # e2e rows measure the FLAGSHIP arch (round-4 verdict: the artifact's
         # headline arch had no end-to-end number of record); one warp64
         # HBM row rides along for cross-round comparability with the
         # round-3/4 wall-clock study in BASELINE.md.
-        plain = measure_e2e(get_config("sprint64", **kw))
+        # The plain row doubles as the live-SLO capture: an ambient run
+        # dir + window aggregator ride the Trainer's own dispatch path
+        # (a handful of span emits per dispatch group — no measurable
+        # overhead at this cadence) and the resulting data-wait/queue
+        # window percentiles land in the gate summary below.
+        slo_dir = tempfile.mkdtemp(prefix="bench_slo_")
+        obs.init_run(slo_dir, extra={"cmd": "bench_e2e"}, process_index=0)
+        obs_windows.install(obs_windows.WindowAggregator())
+        try:
+            plain = measure_e2e(get_config("sprint64", **kw))
+        finally:
+            obs.close_run()  # flushes the final window cycle
+        slo_fields = _window_gate_fields(slo_dir)
+        import shutil
+
+        shutil.rmtree(slo_dir, ignore_errors=True)  # read once, never kept
         piped = measure_e2e(
             get_config("sprint64", steps_per_dispatch=E2E_K, **kw)
         )
@@ -232,6 +286,7 @@ def _measure_round(platform: str) -> dict:
             "e2e_warp64_hbm_samples_per_sec":
                 warp_hbm["e2e_samples_per_sec"],
             "e2e_warp64_hbm_spread_pct": warp_hbm["e2e_spread_pct"],
+            **slo_fields,
         }
     out = {
         "metric": "featurenet64_train_throughput",
@@ -293,6 +348,24 @@ def _measure_round(platform: str) -> dict:
     out["gate_summary"] = obs_gates.make_baseline(
         values, tolerance=GATE_TOLERANCE
     )
+    # Spread pins bound measurement quality, not performance; give them
+    # the absolute slack (see SPREAD_TOLERANCE_ABS) so honest noisy
+    # rounds pass while a blown-up spread still fails the self-check.
+    # The window pins sit near ZERO by design on a healthy pipeline
+    # (a well-fed consumer barely waits), where a relative tolerance
+    # pins "never change" — give them absolute room too: the gate is
+    # for a starving round (p99 jumping by milliseconds, depth
+    # collapsing past a whole slot), not sub-ms wiggle.
+    for noisy, slack in (
+        ("spread_pct", SPREAD_TOLERANCE_ABS),
+        ("serving_spread_pct", SPREAD_TOLERANCE_ABS),
+        ("window_data_wait_p50_ms", 1.0),
+        ("window_data_wait_p99_ms", 5.0),
+        ("window_queue_depth_p50", 1.0),
+    ):
+        pin = out["gate_summary"]["gates"].get(noisy)
+        if pin is not None:
+            pin["tolerance_abs"] = slack
     if os.path.exists(GATE_BASELINE):
         try:
             out["gate"] = obs_gates.evaluate_gates(

@@ -200,8 +200,11 @@ def fault_sites_rule(tree: Tree) -> list[Finding]:
 
 # Modules whose functions sit on (or next to) the dispatch hot path: every
 # host sync here serializes the pipeline, so each one must be deliberate
-# and say why. Package-relative paths.
-HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py")
+# and say why. Package-relative paths. data/dataset.py is the consumer
+# path of the prefetcher — put_batch and the ticket loop run once per
+# dispatch group, so a stray readback there stalls every step.
+HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py",
+                    "data/dataset.py")
 
 
 def _is_host_sync(node: ast.Call) -> Optional[str]:
@@ -214,9 +217,10 @@ def _is_host_sync(node: ast.Call) -> Optional[str]:
             return "jax.device_get"
         if f.attr == "block_until_ready":
             return "block_until_ready"
-        if (f.attr == "asarray" and isinstance(f.value, ast.Name)
+        if (f.attr in ("asarray", "ascontiguousarray")
+                and isinstance(f.value, ast.Name)
                 and f.value.id in ("np", "numpy")):
-            return "np.asarray"
+            return f"np.{f.attr}"
     elif isinstance(f, ast.Name) and f.id == "block_until_ready":
         return "block_until_ready"
     return None
@@ -543,5 +547,56 @@ def config_cli_rule(tree: Tree) -> list[Finding]:
                 "config-cli", "stale_exemption", cfg_mod.path, 0,
                 f"CLI_EXEMPT_FIELDS lists {field!r} but the field IS "
                 "CLI-reachable — drop the stale entry",
+            ))
+    return findings
+
+
+# --- rule 6: span-name drift -------------------------------------------------
+
+@register("spans")
+def span_names_rule(tree: Tree) -> list[Finding]:
+    """Span-literal call sites vs the report's span registry, both ways.
+
+    The report keys its aggregations off span-name literals
+    (``LOOP_CATEGORIES`` for the step-time breakdown,
+    ``KNOWN_SPAN_NAMES`` for everything else — serving latency, window
+    metrics, the recovery sections). A renamed emit site would silently
+    fall out of its section; a ``LOOP_CATEGORIES`` entry whose last call
+    site was deleted would render a breakdown row that always reads zero.
+    Only obs-owned calls are under the contract (``obs.span(...)`` or a
+    bare ``span(...)`` imported from obs); a non-literal name is a
+    generic forwarder, unresolvable here by design.
+    """
+    from featurenet_tpu.obs.report import KNOWN_SPAN_NAMES, LOOP_CATEGORIES
+
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) != "span":
+                continue
+            if _call_owner(node) not in (None, "obs"):
+                continue
+            name = _str_arg(node)
+            if name is None:
+                continue
+            seen.add(name)
+            if name not in KNOWN_SPAN_NAMES:
+                findings.append(Finding(
+                    "spans", "unknown_span", mod.path, node.lineno,
+                    f"span name {name!r} is not declared in "
+                    "obs.report.KNOWN_SPAN_NAMES — the report/window "
+                    "layers would silently ignore it; add it to the "
+                    "registry or fix the typo",
+                ))
+    for cat in LOOP_CATEGORIES:
+        if cat not in seen:
+            findings.append(Finding(
+                "spans", "dead_category", tree.root, 0,
+                f"report.LOOP_CATEGORIES attributes {cat!r} but no span "
+                "call site emits it — its step-time breakdown row would "
+                "always read zero (dead category)",
             ))
     return findings

@@ -120,10 +120,20 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "(featurenet_tpu.faults): deterministically inject "
                         "failures — checkpoint_corrupt@save=2, "
                         "sigterm@step=120, producer_crash@batch=40, "
-                        "sink_enospc@emit=10 … — to exercise the recovery "
-                        "paths; each fault fires once per run (markers in "
-                        "--run-dir), or once per every=M counter stride "
-                        "for soak testing (per-firing markers)")
+                        "sink_enospc@emit=10, producer_slow@batch=8 … — to "
+                        "exercise the recovery paths; each fault fires once "
+                        "per run (markers in --run-dir), or once per "
+                        "every=M counter stride for soak testing "
+                        "(per-firing markers)")
+    p.add_argument("--alert-rules", dest="alert_rules",
+                   help="live SLO alert rules "
+                        "'metric(>|<)threshold[:severity],...' "
+                        "(featurenet_tpu.obs.alerts), evaluated over the "
+                        "run's rolling windows with --run-dir — e.g. "
+                        "'data_wait_fraction>0.6:critical,"
+                        "serving_p99_ms>20'; default: the built-in rule "
+                        "set (data-wait fraction, step p99/median ratio, "
+                        "heartbeat age, cross-host data-wait spread)")
 
 
 def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
@@ -157,6 +167,7 @@ def _overrides(args) -> dict:
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
         "augment_translate_vox", "init_from", "inject_faults",
+        "alert_rules",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
@@ -391,7 +402,7 @@ def main(argv=None) -> None:
                         metavar="NAME",
                         help="run only this rule family (repeatable): "
                              "telemetry, fault-sites, host-sync, hygiene, "
-                             "config-cli")
+                             "config-cli, spans")
     p_rep = sub.add_parser("report", allow_abbrev=False,
                            help="analyze a run directory's observability "
                                 "log (featurenet_tpu.obs): step-time "
@@ -409,8 +420,10 @@ def main(argv=None) -> None:
     p_rep.add_argument("--follow", action="store_true",
                        help="live tail: re-read the event stream(s) "
                             "incrementally and re-render the report every "
-                            "few seconds while the run is hot; exits on "
-                            "Ctrl-C or when the run ends")
+                            "few seconds while the run is hot, with the "
+                            "latest SLO window percentiles and active "
+                            "alerts under the header; exits on Ctrl-C or "
+                            "when the run ends")
     p_rep.add_argument("--interval", type=float, default=3.0,
                        help="--follow re-render period in seconds "
                             "(default 3)")
@@ -896,6 +909,12 @@ def main(argv=None) -> None:
                 print(json.dumps(row))
             else:
                 print(json.dumps(dataclasses.asdict(r)))
+        if getattr(args, "run_dir", None):
+            # Flush the serving-latency window summaries (a batch of STLs
+            # rarely outlives the emit period) and release the sink.
+            from featurenet_tpu import obs
+
+            obs.close_run()
         return
 
     if getattr(args, "debug_nans", False):

@@ -179,6 +179,16 @@ class Config:
     # One-shot (or, with every=, per-firing) markers live in run_dir, so a
     # supervised run's respawned children don't re-fire the same fault.
     inject_faults: Optional[str] = None
+    # Live SLO alert rules (featurenet_tpu.obs.alerts): comma list of
+    # "metric(>|<)threshold[:severity]" entries evaluated over the run's
+    # rolling windows — e.g. "data_wait_fraction>0.6:critical,
+    # serving_p99_ms>20". None = the default rule set (data-wait
+    # fraction, step-time p99/median ratio, heartbeat age, cross-host
+    # data-wait spread); an explicit spec replaces it. Violations fire
+    # structured `alert` events — rendered by `cli report` (SLO section)
+    # and `--follow` — and are never load-bearing. Only meaningful with
+    # run_dir (no sink, no windows).
+    alert_rules: Optional[str] = None
     # Liveness: when set, the Trainer touches this file at every confirmed
     # point of progress (a device readback, an eval, a checkpoint). A
     # supervisor (train.supervisor / `cli train --supervise`) watches the
@@ -221,6 +231,13 @@ class Config:
             from featurenet_tpu import faults as _faults
 
             _faults.parse_spec(self.inject_faults)
+        if self.alert_rules:
+            # Same refusal convention: an alert rule naming a metric that
+            # does not exist would silently never evaluate — an SLO that
+            # watches nothing.
+            from featurenet_tpu.obs.alerts import parse_rules as _rules
+
+            _rules(self.alert_rules)
         if self.seg_loss not in ("balanced_ce", "ce_dice", "dice"):
             raise ValueError(f"unknown seg_loss {self.seg_loss!r}")
         if self.seg_input_context not in ("none", "proj", "proj_coords"):

@@ -209,6 +209,7 @@ def put_batch(batch, sharding):
     def assemble(x, s):
         block = _local_block(s, x.shape)
         if any(b != slice(None) for b in block):
+            # lint: allow-host-sync(host feed block copy; x is host numpy)
             x = np.ascontiguousarray(x[block])
         return jax.make_array_from_process_local_data(s, x)
 
@@ -273,6 +274,13 @@ def prefetch_to_device(
                     while not stop.is_set():
                         time.sleep(0.05)
                     return
+                if faults.maybe_fail("producer_slow", batch=ticket):
+                    # Latency, not death: the slow-producer shape (a cold
+                    # cache, a contended host) that starves the device
+                    # without tripping any crash path — exactly what the
+                    # data-wait SLO alert must catch (with :every=, a
+                    # sustained drag rather than one hiccup).
+                    time.sleep(faults.SLOW_SLEEP_S)
                 # Per-batch generation timing (obs gauge): how long this
                 # worker spent producing, independent of backpressure
                 # waits — the report's "is generation the bottleneck"
@@ -340,6 +348,7 @@ def prefetch_to_device(
             # at max = producers saturate the lookahead and the device is
             # the bottleneck.
             obs.gauge("prefetch_queue_depth", depth)
+            obs.observe("queue_depth", depth)
             if isinstance(item, _WorkerDone):
                 done_workers.add(nxt % W)
             elif isinstance(item, BaseException):

@@ -71,10 +71,20 @@ SITES = {
     "sigterm": "step",                   # exact train-loop step number
     "producer_crash": "batch",           # prefetch ticket ordinal
     "producer_hang": "batch",            # prefetch ticket ordinal
+    "producer_slow": "batch",            # prefetch ticket ordinal (latency)
     "cache_read_error": "read",          # Nth cache _gather call
     "sink_enospc": "emit",               # Nth EventSink.emit
     "spawn_fail": "spawn",               # Nth supervisor child spawn
+    "save_slow": "save",                 # Nth CheckpointManager.save (latency)
 }
+
+# How long the latency-injection sites (producer_slow, save_slow) sleep
+# per firing. Latency, not death: slow is the failure mode the SLO alert
+# layer exists for — a producer that merely drags starves the device
+# without ever tripping a crash/stall recovery path. Long enough to
+# dominate a smoke-sized step so the data-wait alert provably fires;
+# short enough that a :every= soak stays cheap.
+SLOW_SLEEP_S = 0.25
 
 
 def parse_spec(spec: str) -> dict[str, Optional[tuple]]:

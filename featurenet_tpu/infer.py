@@ -201,6 +201,7 @@ class Predictor:
                 if ax:
                     rot = np.flip(rot, ax)
                 rots.append(rot)
+            # lint: allow-host-sync(host-built rotation stack, never on device)
             stacked = np.ascontiguousarray(np.concatenate(rots, axis=0))
             p = self._batched_forward(stacked)
             probs = p.reshape(len(CUBE_GROUP), n, -1).mean(axis=0)

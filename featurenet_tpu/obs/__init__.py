@@ -32,6 +32,15 @@ track per host (``spans.chrome_trace``); ``--validate`` lints the event
 schema; ``--gate baseline.json`` evaluates regression gates (``gates.py``)
 and exits non-zero on a regression.
 
+Live SLOs ride the same stream (``windows.py`` + ``alerts.py``): an
+in-process rolling-window aggregator keeps the last N steps' step-time /
+data-wait / queue-depth / heartbeat-age / serving-latency percentiles and
+periodically emits ``window_summary`` events; declarative alert rules
+(``Config.alert_rules`` / ``--alert-rules``, with sane defaults) fire
+structured ``alert`` events when a window goes bad — rendered live by
+``report --follow`` and post-hoc in the report's SLO section, and never
+load-bearing.
+
 With no run_dir configured every hook is a no-op behind a single ``None``
 check — no file I/O, no timestamps, no measurable train-step overhead.
 This package imports only the stdlib (plus the equally dependency-free
@@ -50,6 +59,8 @@ from featurenet_tpu.obs.events import (
     warn,
 )
 from featurenet_tpu.obs.spans import chrome_trace, span
+from featurenet_tpu.obs.windows import flush as flush_windows
+from featurenet_tpu.obs.windows import observe
 
 __all__ = [
     "EventSink",
@@ -58,8 +69,10 @@ __all__ = [
     "close_run",
     "emit",
     "events_filename",
+    "flush_windows",
     "gauge",
     "init_run",
+    "observe",
     "span",
     "warn",
 ]

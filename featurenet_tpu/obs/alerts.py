@@ -1,0 +1,167 @@
+"""Declarative SLO alert rules over the rolling-window aggregator.
+
+The windows layer (``obs.windows``) answers "what are the last N steps'
+percentiles"; this module answers "is that bad?". A rule is a threshold
+over a window-derived metric::
+
+    data_wait_fraction>0.5:warning
+
+evaluated every time the aggregator emits its ``window_summary`` events;
+a violated rule fires one structured ``alert`` event per emission cycle
+(``rule`` / ``severity`` / ``value`` / ``threshold`` / ``window``). The
+emission cadence bounds the alert rate, and alerts are *never*
+load-bearing — the engine only ever writes telemetry, and the sink it
+writes through already degrades to a no-op on ENOSPC.
+
+Rule DSL (``Config.alert_rules`` / ``--alert-rules``, comma-separated)::
+
+    metric(>|<)threshold[:severity]
+
+``metric`` is either a derived metric (``DERIVED_METRICS``) or a window
+percentile ``<window>_<stat>`` (``data_wait_ms_p99``, ``queue_depth_p50``,
+…); severity is one of ``SEVERITIES`` (default ``warning``). A custom
+spec *replaces* the defaults — the operator takes full control. A typo'd
+metric fails at config time (the same refusal convention as the faults
+DSL): a rule that can silently never evaluate is an SLO that tests
+nothing.
+
+One rule is cross-host by nature: ``data_wait_spread`` (the max-min
+spread of per-host data-wait fractions — free throughput on a lockstep
+mesh). No single process can see it, so it carries ``scope="report"``
+and is judged where the streams merge: the report/live-tail layer and
+the regression gates, not the in-process engine.
+
+Stdlib-only, like the rest of the obs package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+from featurenet_tpu.obs import events as _events
+
+SEVERITIES = ("info", "warning", "critical")
+
+# The raw rolling windows the aggregator maintains (obs.windows keys its
+# ring buffers off this tuple — defined here so the rule parser and the
+# aggregator can never disagree on the metric universe).
+WINDOW_METRICS = (
+    "step_ms",          # per-step loop wall (dispatch + paced readback)
+    "data_wait_ms",     # host blocked on the prefetcher, per dispatch group
+    "queue_depth",      # prefetch queue depth at each consumer pop
+    "heartbeat_age_s",  # inter-beat age at each confirmed progress point
+    "serving_ms",       # per-batch infer latency (the infer_batch span)
+)
+
+_WINDOW_STATS = ("p50", "p95", "p99", "max", "mean")
+
+# Metrics computed *across* windows rather than read off one of them.
+DERIVED_METRICS = (
+    "data_wait_fraction",   # sum(data_wait_ms) / sum(step_ms)
+    "step_p99_ratio",       # p99(step_ms) / p50(step_ms) — tail blowup
+    "heartbeat_age_s",      # max of the heartbeat window
+    "queue_depth",          # p50 of the depth window (starvation reads low)
+    "serving_p99_ms",       # p99 of the serving window
+    "data_wait_spread",     # cross-host; report-scope only (see module doc)
+)
+
+REPORT_SCOPE_METRICS = frozenset({"data_wait_spread"})
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    metric: str
+    op: str  # ">" (higher is worse) or "<" (lower is worse)
+    threshold: float
+    severity: str = "warning"
+
+    @property
+    def scope(self) -> str:
+        return ("report" if self.metric in REPORT_SCOPE_METRICS
+                else "process")
+
+    def violated(self, value: float) -> bool:
+        return value > self.threshold if self.op == ">" else \
+            value < self.threshold
+
+
+# Sane defaults (the ISSUE's four): a starving device, a blown step-time
+# tail, a heartbeat going quiet well before the supervisor's 600 s kill,
+# and (report-scope) a fat cross-host data-wait spread.
+DEFAULT_RULES = (
+    AlertRule("data_wait_fraction", ">", 0.5, "warning"),
+    AlertRule("step_p99_ratio", ">", 4.0, "warning"),
+    AlertRule("heartbeat_age_s", ">", 60.0, "critical"),
+    AlertRule("data_wait_spread", ">", 0.25, "warning"),
+)
+
+
+def known_metrics() -> set[str]:
+    out = set(DERIVED_METRICS)
+    for m in WINDOW_METRICS:
+        out.update(f"{m}_{s}" for s in _WINDOW_STATS)
+    return out
+
+
+_RULE_RE = re.compile(
+    r"^(?P<metric>[a-z0-9_]+)(?P<op>[<>])(?P<threshold>[0-9.eE+-]+)"
+    r"(?::(?P<severity>[a-z]+))?$"
+)
+
+
+def parse_rules(spec: Optional[str]) -> list[AlertRule]:
+    """Parse an ``--alert-rules`` spec; ``None``/empty = the default set.
+    Validates metric names, operators, thresholds, and severities so a
+    typo fails the run at config time, not silently at alert time."""
+    if not spec:
+        return list(DEFAULT_RULES)
+    rules: list[AlertRule] = []
+    seen: set[str] = set()
+    valid = known_metrics()
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _RULE_RE.match(entry)
+        if m is None:
+            raise ValueError(
+                f"malformed alert rule {entry!r}: expected "
+                "metric(>|<)threshold[:severity]"
+            )
+        metric = m.group("metric")
+        if metric not in valid:
+            raise ValueError(
+                f"unknown alert metric {metric!r} in {entry!r}; known: "
+                f"{', '.join(sorted(valid))}"
+            )
+        if metric in seen:
+            raise ValueError(f"duplicate alert metric {metric!r} in {spec!r}")
+        seen.add(metric)
+        try:
+            threshold = float(m.group("threshold"))
+        except ValueError:
+            raise ValueError(
+                f"alert threshold in {entry!r} must be a number"
+            ) from None
+        severity = m.group("severity") or "warning"
+        if severity not in SEVERITIES:
+            raise ValueError(
+                f"unknown alert severity {severity!r} in {entry!r}; "
+                f"one of {', '.join(SEVERITIES)}"
+            )
+        rules.append(AlertRule(metric, m.group("op"), threshold, severity))
+    if not rules:
+        raise ValueError(f"empty alert-rules spec {spec!r}")
+    return rules
+
+
+def fire(rule: AlertRule, value: float, window: int) -> None:
+    """One structured ``alert`` event for a violated rule. ``window`` is
+    the aggregator's emission sequence number — the report marks a rule
+    ACTIVE only while its last alert's window matches the latest summary,
+    so a long-recovered alert never reads as live."""
+    _events.emit("alert", rule=rule.metric, severity=rule.severity,
+                 value=round(float(value), 6), threshold=rule.threshold,
+                 window=window)

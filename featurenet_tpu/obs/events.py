@@ -225,14 +225,27 @@ def init_run(run_dir: str, config: Optional[dict] = None,
     global _sink
     if process_index is None:
         process_index = _device_topology().get("process_index", 0) or 0
+    # The live-SLO layer rides the sink: a (default-rule) window
+    # aggregator exists whenever a run is active, so serving/ingest
+    # processes get rolling windows without a Trainer in the process.
+    # Function-level import: windows imports this module at its top.
+    from featurenet_tpu.obs import windows as _windows
+
     with _install_lock:
         target = os.path.abspath(run_dir)
         filename = events_filename(process_index)
         path = os.path.join(target, filename)
         if _sink is None or _sink.path != path:
             if _sink is not None:
+                # Switching runs: the old run's final window cycle goes
+                # into the OLD stream, then the aggregator is dropped —
+                # run B's first summary must come from run B's samples
+                # (and run B's rules), not run A's ring buffers.
+                _windows.flush()
+                _windows.uninstall()
                 _sink.close()
             _sink = EventSink(target, filename=filename)
+        _windows.ensure_default()
         if process_index == 0:
             manifest_path = os.path.join(target, MANIFEST_FILENAME)
             if not os.path.exists(manifest_path):
@@ -278,6 +291,14 @@ def warn(name: str, msg: str, **fields) -> None:
 
 def close_run() -> None:
     global _sink
+    from featurenet_tpu.obs import windows as _windows
+
+    # Flush pending window summaries (and their alert evaluation) while
+    # the sink can still write them, then drop the aggregator with the
+    # sink — obs state must never leak across runs in one process.
+    if _sink is not None:
+        _windows.flush()
+    _windows.uninstall()
     with _install_lock:
         if _sink is not None:
             _sink.close()

@@ -50,6 +50,15 @@ DIRECTIONS = {
     "stalls": "max",
     "heartbeat_max_age_s": "max",
     "bad_lines": "max",
+    # Cross-host data-wait spread (report.host_skew): a fat spread on a
+    # lockstep mesh is free throughput — a widening one is a regression.
+    "data_wait_spread": "max",
+    # Live-SLO window percentiles (bench records the last window of its
+    # e2e row — see bench._window_gate_fields). Queue depth regresses
+    # DOWNWARD: a pipeline pinned at 0 is a starving device.
+    "window_data_wait_p50_ms": "max",
+    "window_data_wait_p99_ms": "max",
+    "window_queue_depth_p50": "min",
     # bench summary keys (see bench_gate_values)
     "value": "min",
     "serving_inferences_per_sec_per_chip": "min",
@@ -83,13 +92,24 @@ def report_gate_values(rep: dict) -> dict[str, float]:
     hb = rep.get("heartbeat")
     if hb and hb.get("max_age_s") is not None:
         vals["heartbeat_max_age_s"] = hb["max_age_s"]
+    # Multi-host runs: the cross-host data-wait spread is gateable — a
+    # lockstep mesh's global step time is its slowest host's, so a
+    # widening spread is throughput leaking even when host 0 looks fine
+    # (ROADMAP obs-next item).
+    dwf = (rep.get("host_skew") or {}).get("data_wait_fraction")
+    if dwf and dwf.get("spread") is not None:
+        vals["data_wait_spread"] = dwf["spread"]
     vals["bad_lines"] = float(rep.get("bad_lines", 0))
     return vals
 
 
 # Bench-summary keys worth pinning round over round (bench.py's output
-# dict). Spreads are deliberately absent: they bound measurement quality,
-# not performance, and gating them would fail honest noisy rounds.
+# dict). The spread keys bound measurement QUALITY, not performance —
+# they are pinned so a blown-up spread (a contaminated session quoting a
+# lucky draw) is itself a gate failure, but bench gives them a generous
+# absolute slack (bench.SPREAD_TOLERANCE_ABS) so honest noisy rounds
+# still pass. The window_* keys are the live-SLO percentiles of the e2e
+# row (bench._window_gate_fields), present only when the e2e cache is.
 BENCH_GATE_KEYS = (
     "value",
     "serving_inferences_per_sec_per_chip",
@@ -97,6 +117,11 @@ BENCH_GATE_KEYS = (
     "e2e_samples_per_sec",
     "e2e_pipelined_samples_per_sec",
     "e2e_hbm_samples_per_sec",
+    "spread_pct",
+    "serving_spread_pct",
+    "window_data_wait_p50_ms",
+    "window_data_wait_p99_ms",
+    "window_queue_depth_p50",
 )
 
 

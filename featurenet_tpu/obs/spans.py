@@ -26,6 +26,7 @@ import threading
 import time
 
 from featurenet_tpu.obs import events as _events
+from featurenet_tpu.obs import windows as _windows
 
 _tls = threading.local()
 
@@ -72,6 +73,10 @@ class _Span:
             parent=stack[-1] if stack else None,
             **self.fields,
         )
+        # Live-SLO feed: the spans that are window metrics (data_wait,
+        # infer_batch) land in the rolling aggregator too — the duration
+        # is already in hand, so the live view costs no extra clock read.
+        _windows.observe_span(self.name, dur, self.fields)
         return False
 
 
@@ -98,25 +103,46 @@ def chrome_trace(events: list[dict]) -> dict:
     synthetic trace pid — OS pids from different hosts can collide, so the
     raw pid cannot be the track key in a merged multi-host log — with a
     ``process_name`` metadata record naming the host and real pid, and
-    ``process_sort_index`` ordering tracks by host."""
+    ``process_sort_index`` ordering tracks by host.
+
+    ``window_summary`` events export as counter ("ph":"C") tracks — one
+    per metric — so the rolling p50/p95/p99 render as stepped series
+    above the span lanes they summarize."""
     spans = [e for e in events if e.get("ev") == "span" and "dur_s" in e]
-    if not spans:
+    windows = [e for e in events
+               if e.get("ev") == "window_summary" and "metric" in e]
+    if not spans and not windows:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    t0 = min(e["t"] for e in spans)
+    t0 = min(e["t"] for e in spans + windows)
     track_ids: dict[tuple, int] = {}
-    out = []
-    for e in spans:
+
+    def track(e: dict) -> int:
         key = (e.get("process_index", 0) or 0, e.get("pid", 0))
         if key not in track_ids:
             track_ids[key] = len(track_ids)
+        return track_ids[key]
+
+    out = []
+    for e in spans:
         out.append({
             "name": e.get("name", "?"),
             "ph": "X",
             "ts": (e["t"] - t0) * 1e6,
             "dur": e["dur_s"] * 1e6,
-            "pid": track_ids[key],
+            "pid": track(e),
             "tid": e.get("thread", 0),
             "args": {k: v for k, v in e.items() if k not in _SPAN_META},
+        })
+    for e in windows:
+        out.append({
+            "name": f"window {e['metric']}",
+            "ph": "C",
+            "ts": (e["t"] - t0) * 1e6,
+            "pid": track(e),
+            "args": {
+                k: e[k] for k in ("p50", "p95", "p99")
+                if isinstance(e.get(k), (int, float))
+            },
         })
     meta = []
     for (host, ospid), tpid in sorted(track_ids.items(), key=lambda kv: kv[1]):

@@ -1,0 +1,250 @@
+"""Streaming rolling-window aggregation: the live half of the obs layer.
+
+The report (``obs.report``) judges a run after the fact; production
+degradation has to be seen *while it happens*. This module maintains
+in-process ring buffers — the last N samples / T seconds — of the step
+loop's health signals (``alerts.WINDOW_METRICS``: step time, data-wait,
+prefetch queue depth, heartbeat age, serving latency), computes their
+p50/p95/p99 online, and periodically emits one ``window_summary`` event
+per metric. Every sample is a host-side float the instrumentation
+already had in hand (a span's ``perf_counter`` duration, a queue length)
+— the aggregator never touches a device value, so watching the run costs
+no host sync.
+
+On each emission cycle the configured alert rules (``obs.alerts``) are
+evaluated against the windows and violated rules fire structured
+``alert`` events. Telemetry is never load-bearing: everything here only
+*writes* events, through a sink that already degrades to a no-op on
+write failure.
+
+Like the event sink, the aggregator is process-wide and optional:
+``observe``/``observe_span`` with none installed are one module
+attribute load and a ``None`` check — the un-instrumented dispatch path
+pays nothing. ``events.init_run`` installs a default-rule aggregator
+alongside the sink; the Trainer replaces it with one built from
+``Config.alert_rules``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from featurenet_tpu.obs import alerts as _alerts
+from featurenet_tpu.obs import events as _events
+# ONE percentile implementation for the live and post-hoc views: a
+# formula change in the report must change the windows with it, never
+# silently diverge the two (the schema-drift class the linter polices).
+from featurenet_tpu.obs.report import _pct
+
+# Span names that feed a window directly: (metric, unit scale, divisor
+# field). Span durations are seconds; the windows speak milliseconds.
+# The divisor keeps samples PER-STEP comparable: a fused dispatch's
+# data_wait span covers `take` steps at once, and without the
+# normalization data_wait_fraction would read k× too high on pipelined
+# runs (step_ms is per-step by construction).
+SPAN_METRICS = {
+    "data_wait": ("data_wait_ms", 1e3, "take"),
+    "infer_batch": ("serving_ms", 1e3, None),
+}
+
+DEFAULT_WINDOW = 128       # samples per ring buffer (last N steps)
+DEFAULT_MAX_AGE_S = 300.0  # and never older than this (last T seconds)
+DEFAULT_EMIT_EVERY_S = 5.0
+
+
+class RollingWindow:
+    """Ring buffer of (timestamp, value) bounded by count AND age."""
+
+    __slots__ = ("maxlen", "max_age_s", "_samples")
+
+    def __init__(self, maxlen: int = DEFAULT_WINDOW,
+                 max_age_s: Optional[float] = DEFAULT_MAX_AGE_S):
+        self.maxlen = maxlen
+        self.max_age_s = max_age_s
+        self._samples: deque = deque(maxlen=maxlen)
+
+    def add(self, value: float, now: float) -> None:
+        self._samples.append((now, float(value)))
+
+    def values(self, now: float) -> list[float]:
+        if self.max_age_s is not None:
+            cutoff = now - self.max_age_s
+            while self._samples and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+        return [v for _, v in self._samples]
+
+    def summary(self, now: float) -> Optional[dict]:
+        vals = sorted(self.values(now))
+        if not vals:
+            return None
+        return {
+            "n": len(vals),
+            "p50": round(_pct(vals, 50), 4),
+            "p95": round(_pct(vals, 95), 4),
+            "p99": round(_pct(vals, 99), 4),
+            "mean": round(sum(vals) / len(vals), 4),
+            "max": round(vals[-1], 4),
+        }
+
+    def total(self, now: float) -> float:
+        return sum(self.values(now))
+
+
+class WindowAggregator:
+    """Rolling windows for every ``alerts.WINDOW_METRICS`` metric, with
+    periodic ``window_summary`` emission and alert-rule evaluation.
+
+    ``emit_every_s`` bounds both the event volume and the alert rate: a
+    cycle emits one summary per *dirty* (newly-observed) metric, stamps
+    them all with one monotonically increasing ``seq``, then evaluates
+    the process-scope rules — a violated rule fires one ``alert`` event
+    carrying that ``seq`` as its ``window``. ``flush()`` forces a final
+    cycle (the loop end / ``close_run`` hook), so even a run shorter than
+    the period lands its summaries.
+    """
+
+    def __init__(self, rules: Optional[list] = None,
+                 window: int = DEFAULT_WINDOW,
+                 max_age_s: Optional[float] = DEFAULT_MAX_AGE_S,
+                 emit_every_s: float = DEFAULT_EMIT_EVERY_S):
+        self.rules = list(_alerts.DEFAULT_RULES) if rules is None else \
+            list(rules)
+        self.emit_every_s = emit_every_s
+        self._win = {
+            m: RollingWindow(window, max_age_s)
+            for m in _alerts.WINDOW_METRICS
+        }
+        self._dirty: set[str] = set()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_emit = time.perf_counter()
+
+    def observe(self, metric: str, value: float) -> None:
+        win = self._win.get(metric)
+        if win is None:
+            return  # unknown metric: ignore, never crash instrumentation
+        now = time.perf_counter()
+        with self._lock:
+            win.add(value, now)
+            self._dirty.add(metric)
+            if now - self._last_emit >= self.emit_every_s:
+                self._emit_locked(now)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._emit_locked(time.perf_counter())
+
+    # -- internals (call with self._lock held) ------------------------------
+    def _emit_locked(self, now: float) -> None:
+        if not self._dirty:
+            return
+        self._last_emit = now
+        self._seq += 1
+        for metric in sorted(self._dirty):
+            s = self._win[metric].summary(now)
+            if s is None:
+                continue
+            _events.emit("window_summary", metric=metric, n=s["n"],
+                         p50=s["p50"], p95=s["p95"], p99=s["p99"],
+                         mean=s["mean"], max=s["max"], seq=self._seq)
+        self._dirty.clear()
+        for rule in self.rules:
+            if rule.scope != "process":
+                continue  # cross-host rules are the report's to judge
+            value = self.rule_value(rule.metric, now)
+            if value is not None and rule.violated(value):
+                _alerts.fire(rule, value, self._seq)
+
+    def rule_value(self, metric: str, now: float) -> Optional[float]:
+        """Resolve a rule metric against the current windows: a derived
+        metric, or ``<window>_<stat>`` percentile lookup. None when the
+        backing window(s) have no samples yet."""
+        if metric == "data_wait_fraction":
+            steps = self._win["step_ms"].total(now)
+            if steps <= 0:
+                return None
+            return self._win["data_wait_ms"].total(now) / steps
+        if metric == "step_p99_ratio":
+            vals = sorted(self._win["step_ms"].values(now))
+            p50 = _pct(vals, 50)
+            if not p50:
+                return None
+            return _pct(vals, 99) / p50
+        if metric == "heartbeat_age_s":
+            vals = self._win["heartbeat_age_s"].values(now)
+            return max(vals) if vals else None
+        if metric == "queue_depth":
+            return _pct(sorted(self._win["queue_depth"].values(now)), 50)
+        if metric == "serving_p99_ms":
+            return _pct(sorted(self._win["serving_ms"].values(now)), 99)
+        base, _, stat = metric.rpartition("_")
+        win = self._win.get(base)
+        if win is not None and stat in ("p50", "p95", "p99", "max", "mean"):
+            s = win.summary(now)
+            return None if s is None else s[stat]
+        return None
+
+
+# --- module-level (process-wide) aggregator ----------------------------------
+
+_agg: Optional[WindowAggregator] = None
+
+
+def install(agg: Optional[WindowAggregator]) -> None:
+    global _agg
+    _agg = agg
+
+
+def uninstall() -> None:
+    global _agg
+    _agg = None
+
+
+def active() -> bool:
+    return _agg is not None
+
+
+def ensure_default() -> None:
+    """Install a default-rule aggregator if none exists (``init_run``'s
+    hook, so ``cli infer --run-dir`` gets serving-latency windows without
+    any Trainer in the process)."""
+    global _agg
+    if _agg is None:
+        _agg = WindowAggregator()
+
+
+def observe(metric: str, value: float) -> None:
+    """Feed one sample; no-op (one None check) when no aggregator."""
+    agg = _agg
+    if agg is None:
+        return
+    agg.observe(metric, value)
+
+
+def observe_span(name: str, dur_s: float,
+                 fields: Optional[dict] = None) -> None:
+    """Span-exit hook (``obs.spans``): route the spans that ARE window
+    metrics (``SPAN_METRICS``) into their ring buffers, normalized by
+    the span's divisor field (a fused dispatch's data_wait covers
+    ``take`` steps — the sample must be per-step)."""
+    agg = _agg
+    if agg is None:
+        return
+    m = SPAN_METRICS.get(name)
+    if m is None:
+        return
+    value = dur_s * m[1]
+    if m[2] is not None and fields:
+        div = fields.get(m[2])
+        if isinstance(div, (int, float)) and div > 1:
+            value /= div
+    agg.observe(m[0], value)
+
+
+def flush() -> None:
+    agg = _agg
+    if agg is not None:
+        agg.flush()

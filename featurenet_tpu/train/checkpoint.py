@@ -12,6 +12,7 @@ import json
 import os
 import shutil
 import sys
+import time
 from typing import Optional
 
 import jax
@@ -110,6 +111,12 @@ class CheckpointManager:
         # background write's completion is bounded by checkpoint_wait.
         self._saves += 1
         with obs.span("checkpoint_save", step=step):
+            if faults.maybe_fail("save_slow", save=self._saves):
+                # Latency injection: a dragging filesystem/serialization
+                # stretching the host-blocking half of the save — the span
+                # wraps it, so the slowness lands attributed in the report
+                # instead of as unexplained "other" time.
+                time.sleep(faults.SLOW_SLEEP_S)
             self._mgr.save(step, args=ocp.args.StandardSave(payload))
         if faults.maybe_fail("checkpoint_corrupt", save=self._saves):
             # Wait for the async write to finalize, then truncate the step

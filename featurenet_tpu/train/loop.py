@@ -102,6 +102,16 @@ class Trainer:
             obs.init_run(self.cfg.run_dir,
                          config=config_to_dict(self.cfg),
                          process_index=jax.process_index())
+            # Live SLO layer (obs.windows/alerts): rolling windows of step
+            # time / data-wait / queue depth / heartbeat age / serving
+            # latency with this run's alert rules; replaces init_run's
+            # default-rule aggregator. Every sample is a host-side float
+            # the instrumentation already had — no host-sync cost.
+            from featurenet_tpu.obs import alerts, windows
+
+            windows.install(windows.WindowAggregator(
+                rules=alerts.parse_rules(self.cfg.alert_rules)
+            ))
         # Chaos plan (featurenet_tpu.faults): installed before any layer
         # that hosts an injection site runs. One-shot markers go to the
         # run_dir (shared across a supervised run's respawns) so a fault
@@ -443,6 +453,10 @@ class Trainer:
         last = getattr(self, "_last_beat", None)
         obs.emit("heartbeat",
                  age_s=round(now - last, 3) if last is not None else None)
+        if last is not None:
+            # SLO window: inter-beat age trend — the live precursor of
+            # the supervisor's stall verdict.
+            obs.observe("heartbeat_age_s", round(now - last, 3))
         self._last_beat = now
         if self.cfg.heartbeat_file:
             from featurenet_tpu.train.supervisor import touch_heartbeat
@@ -667,6 +681,7 @@ class Trainer:
                 # segment; the remainder (total % k, segment cuts) runs
                 # single steps — cadences keep exact step semantics.
                 take = self._k if self._k > 1 and step + self._k <= stop else 1
+                t_iter = time.perf_counter()
                 metrics = self.dispatch_group(stream, take)
                 new_step = step + take
                 pending.append(metrics["loss"])
@@ -674,6 +689,15 @@ class Trainer:
                     with obs.span("readback", step=new_step):
                         float(pending.popleft())  # readback = progress proof
                     self._heartbeat()
+                # SLO window: per-step time of the dispatch+paced-readback
+                # core (eval/checkpoint cadence work deliberately excluded
+                # — those are their own spans, and folding them in would
+                # make the p99-vs-median tail alert fire on every healthy
+                # eval boundary).
+                obs.observe(
+                    "step_ms",
+                    round((time.perf_counter() - t_iter) / take * 1e3, 3),
+                )
                 if trace_active and (
                     new_step >= trace_start + cfg.profile_steps
                     or new_step == total
@@ -724,6 +748,10 @@ class Trainer:
                 signal.signal(signal.SIGTERM, prev_sigterm)
             obs.emit("loop_end", step=int(step),
                      wall_s=time.perf_counter() - loop_t0)
+            # Final SLO cycle: a run shorter than the emit period still
+            # lands its window summaries (and their alert evaluation)
+            # before anything reads the stream.
+            obs.flush_windows()
             if stream is not None:
                 # Stop the producer threads and release their lookahead of
                 # device_put batches — a returned run must not keep pinning

@@ -84,16 +84,20 @@ _CORRUPTION_CHECKS = frozenset({
 })
 
 
-def _telemetry_findings(run_dir: str, offsets: dict[str, int]) -> list[dict]:
-    """Schema-lint only the event lines appended since ``offsets`` (this
-    child's lifetime — an old torn line must not condemn every later
-    child). Same lint as ``cli report --validate``, narrowed to the
-    structural-corruption checks (``_CORRUPTION_CHECKS``)."""
-    from featurenet_tpu.obs.report import (
-        _parse_lines,
-        discover_event_files,
-        validate_events,
-    )
+def _window_events(run_dir: str,
+                   offsets: dict[str, int]) -> tuple[list[dict], int]:
+    """Parse only the event lines appended since ``offsets`` (one child's
+    lifetime). A torn TRAILING fragment (no newline at EOF) is the
+    legitimate signature of the sink's ENOSPC degrade path: the short
+    write that killed the sink is the last thing the stream ever got, and
+    the child then finished dark by design. Drop it uncounted (the same
+    partial-trailing-line convention as the live tail's EventTail) —
+    "telemetry went dark" must not be condemned as "telemetry is
+    corrupt". Garbage *followed by more lines* still counts. One parser
+    for the stream format (obs.report._parse_lines): the report's
+    --validate, the telemetry verdict, and the segment gate must never
+    disagree on the same bytes."""
+    from featurenet_tpu.obs.report import _parse_lines, discover_event_files
 
     events: list[dict] = []
     bad = 0
@@ -104,24 +108,109 @@ def _telemetry_findings(run_dir: str, offsets: dict[str, int]) -> list[dict]:
                 data = fh.read()
         except OSError:
             continue
-        # A torn TRAILING fragment (no newline at EOF) is the legitimate
-        # signature of the sink's ENOSPC degrade path: the short write
-        # that killed the sink is the last thing the stream ever got, and
-        # the child then finished dark by design. Drop it uncounted (the
-        # same partial-trailing-line convention as the live tail's
-        # EventTail) — "telemetry went dark" must not be condemned as
-        # "telemetry is corrupt". Garbage *followed by more lines* still
-        # counts.
         if data and not data.endswith(b"\n"):
             data = data[:data.rfind(b"\n") + 1] if b"\n" in data else b""
-        # One parser for the stream format (obs.report._parse_lines): the
-        # report's --validate and this verdict must never disagree on the
-        # same bytes.
         bad += _parse_lines(
             data.decode("utf-8", errors="replace").splitlines(), idx, events
         )
+    events.sort(key=lambda e: e["t"])
+    return events, bad
+
+
+def _telemetry_findings(run_dir: str, offsets: dict[str, int]) -> list[dict]:
+    """Schema-lint only the event lines appended since ``offsets`` (this
+    child's lifetime — an old torn line must not condemn every later
+    child). Same lint as ``cli report --validate``, narrowed to the
+    structural-corruption checks (``_CORRUPTION_CHECKS``)."""
+    from featurenet_tpu.obs.report import validate_events
+
+    events, bad = _window_events(run_dir, offsets)
     return [f for f in validate_events(events, bad_lines=bad)
             if f.get("check") in _CORRUPTION_CHECKS]
+
+
+# --- segment gating (self-pinning regression gates) --------------------------
+
+# Baseline the supervisor auto-pins from the first clean segment's report
+# (obs.gates JSON shape — `cli report --gate <run_dir>/gate_baseline.json`
+# works on it directly).
+GATE_BASELINE_FILENAME = "gate_baseline.json"
+
+# Metrics pinned/judged per segment: the ones a training segment always
+# records. Restart/stall counts are supervisor-cumulative (segment 2
+# would always "regress" them) and serving/heartbeat metrics are absent
+# from short segments — a pin that a later clean segment structurally
+# cannot satisfy would cry wolf on every run.
+SEGMENT_GATE_METRICS = ("step_ms", "data_wait_fraction", "data_wait_spread",
+                        "bad_lines")
+
+# Segments are short and include each child's own compile warmup, so the
+# per-segment tolerance is loose — this gate exists to catch drift
+# (a config change that doubled step time, a host gone sideways), not to
+# re-measure the benchmark.
+SEGMENT_GATE_TOLERANCE = 0.35
+
+
+def segment_gate_values(run_dir: str, offsets: dict[str, int]) -> dict:
+    """Gateable scalars of ONE segment: the report of only the event
+    lines appended during the child's lifetime, narrowed to the metrics
+    every training segment records (``SEGMENT_GATE_METRICS``)."""
+    from featurenet_tpu.obs.gates import report_gate_values
+    from featurenet_tpu.obs.report import build_report
+
+    events, bad = _window_events(run_dir, offsets)
+    rep = build_report(events, bad_lines=bad)
+    vals = report_gate_values(rep)
+    return {k: v for k, v in vals.items() if k in SEGMENT_GATE_METRICS}
+
+
+def _gate_segment(run_dir: str, offsets: dict[str, int], record, log) -> None:
+    """Close the judge loop the post-hoc gate leaves open: after each
+    CLEAN segment, pin a baseline from the first one and judge every
+    later one against it — alerting (``gate_regression`` supervisor
+    event) instead of drifting silently until a human reads a report.
+    Never load-bearing: a gate failure changes no verdict, burns no
+    restart budget, and any internal error degrades to a log line."""
+    from featurenet_tpu.obs import gates as obs_gates
+
+    try:
+        vals = segment_gate_values(run_dir, offsets)
+        if "step_ms" not in vals:
+            return  # no loop ran in this segment: nothing to judge
+        path = os.path.join(run_dir, GATE_BASELINE_FILENAME)
+        if not os.path.exists(path):
+            baseline = obs_gates.make_baseline(
+                vals, tolerance=SEGMENT_GATE_TOLERANCE
+            )
+            # Near-zero baselines (a well-fed pipeline's data-wait
+            # fraction, a tight mesh's spread) get an absolute slack —
+            # a relative tolerance on ~0 pins "never change" and cries
+            # wolf on noise-level wiggles of a tiny number.
+            for name, slack in (("data_wait_fraction", 0.05),
+                                ("data_wait_spread", 0.1)):
+                pin = baseline["gates"].get(name)
+                if pin is not None:
+                    pin["tolerance_abs"] = slack
+            tmp = path + ".tmp"  # atomic: never half a baseline
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(baseline, fh, indent=1)
+            os.replace(tmp, path)
+            log(json.dumps({"supervisor": "auto_pin", "baseline": path,
+                            "metrics": sorted(vals)}))
+            record("auto_pin", baseline=path, metrics=sorted(vals))
+            return
+        result = obs_gates.evaluate_gates(vals, obs_gates.load_baseline(path))
+        if result["ok"]:
+            log(json.dumps({"supervisor": "gate", "ok": True}))
+            record("gate", ok=True)
+        else:
+            log(json.dumps({"supervisor": "gate_regression",
+                            "failed": result["failed"]}))
+            record("gate_regression", failed=result["failed"],
+                   values={k: vals.get(k) for k in result["failed"]})
+    except Exception as e:  # the judge must never kill the run
+        log(json.dumps({"supervisor": "gate_error",
+                        "error": repr(e)[:300]}))
 
 
 def _kill_tree(proc: subprocess.Popen) -> None:
@@ -171,7 +260,12 @@ def supervise(
         ``events.jsonl``, so ``cli report`` reconstructs the restart/stall
         timeline next to the child's own spans. Appends are line-atomic
         across processes (obs.events), so the two writers interleave
-        safely.
+        safely. The judge loop closes here too: the first clean segment's
+        report auto-pins ``<run_dir>/gate_baseline.json``
+        (``SEGMENT_GATE_METRICS`` at a loose per-segment tolerance) and
+        every later clean segment is evaluated against it — ``auto_pin``
+        / ``gate`` / ``gate_regression`` supervisor events, never a
+        restart verdict.
       backoff_base_s / backoff_cap_s: crash-loop backoff. Every *unplanned*
         restart sleeps ``min(cap, base * 2**(n-1))`` (n = consecutive
         unplanned restarts) with jitter in [0.5x, 1x) before respawning,
@@ -225,12 +319,10 @@ def supervise(
         base_mtime = os.path.getmtime(heartbeat_file)
         started = time.monotonic()
         first_beat_seen = False
-        # Telemetry window for this child: only lines appended from here on
-        # are linted for the exit-0 verdict below.
-        offsets = (
-            _stream_offsets(run_dir)
-            if run_dir and validate_telemetry else {}
-        )
+        # Per-child stream window: only lines appended from here on are
+        # linted for the exit-0 verdict below AND folded into the segment
+        # report the self-pinning gate judges.
+        offsets = _stream_offsets(run_dir) if run_dir else {}
         spawns += 1
         spawn_argv = list(argv)
         if faults.maybe_fail("spawn_fail", spawn=spawns):
@@ -318,6 +410,11 @@ def supervise(
                 record("telemetry_corrupt", findings=len(findings),
                        first=findings[0].get("msg"))
         if not stalled and rc == 0 and not telemetry_bad:
+            # A clean final segment is judged (or pins the baseline) like
+            # any other — a run whose LAST segment drifted must not slip
+            # out un-gated just because it was last.
+            if run_dir:
+                _gate_segment(run_dir, offsets, record, log)
             log(json.dumps({"supervisor": "done", "restarts": restarts,
                             "stalls": stalls, "planned": planned}))
             record("done", restarts=restarts, stalls=stalls, planned=planned)
@@ -335,6 +432,11 @@ def supervise(
             planned += 1
             early_fails = 0
             consec_failures = 0  # real progress ends any crash streak
+            # Self-pinning gate: the first clean segment's report becomes
+            # the baseline; every later clean segment is judged against it
+            # (gate_regression event on drift — alert, never a restart).
+            if run_dir:
+                _gate_segment(run_dir, offsets, record, log)
             log(json.dumps({"supervisor": "planned_restart",
                             "count": planned}))
             record("planned_restart", count=planned)

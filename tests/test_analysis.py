@@ -361,6 +361,63 @@ def test_config_cli_stale_exemption_when_field_reachable(tmp_path):
     assert "log_every" in findings[0].msg
 
 
+# --- rule: spans (span-name drift) -------------------------------------------
+
+def _clean_span_source() -> str:
+    """One call site per loop category (plus a known non-loop span) — the
+    spans rule's zero-finding fixture."""
+    from featurenet_tpu.obs.report import LOOP_CATEGORIES
+
+    lines = ["from featurenet_tpu import obs", ""]
+    for name in (*LOOP_CATEGORIES, "infer_batch"):
+        lines.append(f"with obs.span({name!r}):")
+        lines.append("    pass")
+    return "\n".join(lines) + "\n"
+
+
+def test_spans_clean_fixture_passes(tmp_path):
+    _write(tmp_path, "sites.py", _clean_span_source())
+    assert run_lint(str(tmp_path), rules=["spans"]) == []
+
+
+def test_spans_unknown_span_caught_with_location(tmp_path):
+    path = _write(tmp_path, "sites.py", _clean_span_source()
+                  + 'with obs.span("data_wiat"):\n    pass\n')
+    findings = run_lint(str(tmp_path), rules=["spans"])
+    assert [f.check for f in findings] == ["unknown_span"]
+    assert findings[0].path == path and findings[0].line > 0
+    assert "data_wiat" in findings[0].msg
+
+
+def test_spans_dead_category_when_call_site_deleted(tmp_path):
+    """The drift scenario: delete a loop category's last span site and
+    the breakdown row would silently read zero — the lint goes red."""
+    source = _clean_span_source().replace(
+        "with obs.span('data_wait'):\n    pass\n", ""
+    )
+    assert "data_wait" not in source
+    _write(tmp_path, "sites.py", source)
+    findings = run_lint(str(tmp_path), rules=["spans"])
+    assert [f.check for f in findings] == ["dead_category"]
+    assert "'data_wait'" in findings[0].msg and findings[0].line == 0
+
+
+def test_spans_non_literal_and_foreign_span_apis_exempt(tmp_path):
+    """A generic forwarder (non-literal name) and a foreign .span API are
+    not under the contract."""
+    _write(tmp_path, "sites.py", _clean_span_source() + (
+        "def forward(name):\n"
+        "    with obs.span(name):\n"
+        "        pass\n"
+        "class Tracer:\n"
+        "    def span(self, name):\n"
+        "        return name\n"
+        "tracer = Tracer()\n"
+        "tracer.span('not_a_known_span')\n"
+    ))
+    assert run_lint(str(tmp_path), rules=["spans"]) == []
+
+
 # --- output formats / CLI surface --------------------------------------------
 
 def test_text_and_json_output_carry_file_and_line(tmp_path):
@@ -440,6 +497,7 @@ def test_rule_registry_populated_at_import():
 
     assert set(RULE_NAMES) == {
         "telemetry", "fault-sites", "host-sync", "hygiene", "config-cli",
+        "spans",
     }
     assert set(RULES) == set(RULE_NAMES)
 

@@ -895,9 +895,12 @@ def test_bench_gate_summary_and_self_check():
               "serving_inferences_per_sec_per_chip": 48900.0,
               "e2e_samples_per_sec": 9878.0, "spread_pct": 3.8}
     vals = gates.bench_gate_values(round1)
-    assert "spread_pct" not in vals  # measurement quality is not perf
+    # Spreads are pinned too (PR 5 satellite): measurement quality is
+    # itself gated, direction max, with bench adding an absolute slack.
+    assert vals["spread_pct"] == 3.8
     pin = gates.make_baseline(vals, tolerance=0.15)
     assert pin["gates"]["value"]["direction"] == "min"
+    assert pin["gates"]["spread_pct"]["direction"] == "max"
 
     steady = dict(round1, value=16000.0)
     assert gates.evaluate_gates(gates.bench_gate_values(steady), pin)["ok"]

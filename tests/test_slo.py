@@ -1,0 +1,674 @@
+"""Live SLO layer: rolling-window aggregation (obs.windows), declarative
+alert rules (obs.alerts), the report/follow/trace surfacing, latency fault
+injection, and the supervisor's self-pinning segment gates.
+
+The acceptance spine (ISSUE 5): an injected ``producer_slow`` run emits
+``window_summary`` events, fires a data-wait alert visible in both ``cli
+report`` (SLO section) and ``--follow``; a supervised 2-segment run
+auto-pins its gate baseline after segment 1 and gates segment 2.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+from featurenet_tpu import faults, obs
+from featurenet_tpu.config import get_config
+from featurenet_tpu.obs import alerts, windows
+from featurenet_tpu.obs.report import (
+    build_report,
+    follow_report,
+    follow_slo_line,
+    format_report,
+    load_events,
+    validate_events,
+)
+from featurenet_tpu.train.loop import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _isolated_state():
+    """Obs + faults state is process-wide; never leak across tests."""
+    obs.close_run()
+    faults.uninstall()
+    yield
+    obs.close_run()
+    faults.uninstall()
+
+
+# --- rolling windows ---------------------------------------------------------
+
+def test_rolling_window_count_and_age_eviction():
+    w = windows.RollingWindow(maxlen=4, max_age_s=10.0)
+    for i in range(6):
+        w.add(float(i), now=100.0 + i)
+    # Count bound: only the last 4 samples survive.
+    assert w.values(now=106.0) == [2.0, 3.0, 4.0, 5.0]
+    # Age bound: at t=114 samples older than 10s (t<104) are evicted.
+    assert w.values(now=114.9) == [5.0]
+    s = w.summary(now=114.9)
+    assert (s["n"], s["p50"], s["max"]) == (1, 5.0, 5.0)
+    # Fully aged out: no summary rather than a stale one.
+    assert w.summary(now=300.0) is None
+
+
+def test_rolling_window_percentiles_nearest_rank():
+    w = windows.RollingWindow(maxlen=200, max_age_s=None)
+    for i in range(1, 101):
+        w.add(float(i), now=0.0)
+    s = w.summary(now=0.0)
+    assert s["p50"] == 51.0  # nearest-rank on 100 samples: index 50
+    assert s["p95"] == 95.0
+    assert s["p99"] == 99.0
+    assert s["max"] == 100.0
+    assert s["mean"] == pytest.approx(50.5)
+
+
+# --- alert-rule DSL ----------------------------------------------------------
+
+def test_parse_rules_defaults_and_custom():
+    default = alerts.parse_rules(None)
+    assert default == list(alerts.DEFAULT_RULES)
+    assert {r.metric for r in default} >= {
+        "data_wait_fraction", "step_p99_ratio", "heartbeat_age_s",
+        "data_wait_spread",
+    }
+    spread = next(r for r in default if r.metric == "data_wait_spread")
+    assert spread.scope == "report"  # cross-host: the report judges it
+
+    rules = alerts.parse_rules(
+        "data_wait_fraction>0.6:critical,queue_depth<1,serving_ms_p99>20"
+    )
+    assert [r.metric for r in rules] == [
+        "data_wait_fraction", "queue_depth", "serving_ms_p99"
+    ]
+    assert rules[0].severity == "critical" and rules[0].op == ">"
+    assert rules[1].op == "<" and rules[1].severity == "warning"
+    assert rules[2].scope == "process"
+    assert rules[0].violated(0.7) and not rules[0].violated(0.5)
+    assert rules[1].violated(0.0) and not rules[1].violated(2.0)
+
+
+def test_parse_rules_rejects_typos_at_config_time():
+    with pytest.raises(ValueError, match="unknown alert metric"):
+        alerts.parse_rules("data_wait_fracton>0.5")
+    with pytest.raises(ValueError, match="malformed"):
+        alerts.parse_rules("data_wait_fraction=0.5")
+    with pytest.raises(ValueError, match="malformed"):
+        alerts.parse_rules("data_wait_fraction>lots")
+    with pytest.raises(ValueError, match="must be a number"):
+        alerts.parse_rules("data_wait_fraction>1e")
+    with pytest.raises(ValueError, match="unknown alert severity"):
+        alerts.parse_rules("data_wait_fraction>0.5:panic")
+    with pytest.raises(ValueError, match="duplicate"):
+        alerts.parse_rules("queue_depth<1,queue_depth<2")
+    with pytest.raises(ValueError, match="empty"):
+        alerts.parse_rules(" , ")
+    # And Config.validate applies the same refusal.
+    with pytest.raises(ValueError, match="unknown alert metric"):
+        get_config("smoke16", alert_rules="tyop>1")
+
+
+# --- aggregator emission + alert firing --------------------------------------
+
+def test_aggregator_emits_summaries_and_alerts(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    agg = windows.WindowAggregator(
+        rules=alerts.parse_rules("data_wait_fraction>0.5,heartbeat_age_s>30"),
+        emit_every_s=1e9,  # only flush() emits: deterministic one cycle
+    )
+    windows.install(agg)
+    for _ in range(8):
+        obs.observe("step_ms", 100.0)
+        obs.observe("data_wait_ms", 80.0)
+    obs.observe("heartbeat_age_s", 2.0)  # healthy: must NOT alert
+    windows.flush()
+    obs.close_run()
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    assert validate_events(events) == []  # new kinds are schema-known
+    sums = {e["metric"]: e for e in events if e["ev"] == "window_summary"}
+    assert {"step_ms", "data_wait_ms", "heartbeat_age_s"} <= set(sums)
+    s = sums["step_ms"]
+    assert s["n"] == 8 and s["p50"] == 100.0 and s["p99"] == 100.0
+    fired = [e for e in events if e["ev"] == "alert"]
+    assert [e["rule"] for e in fired] == ["data_wait_fraction"]
+    a = fired[0]
+    assert a["value"] == pytest.approx(0.8)
+    assert a["threshold"] == 0.5
+    assert a["severity"] == "warning"
+    assert a["window"] == s["seq"]  # same emission cycle
+
+
+def test_aggregator_periodic_emission_and_span_hook(tmp_path):
+    """The span-exit hook feeds the windows (data_wait/infer_batch), and
+    an elapsed emit period triggers a cycle without any flush."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    windows.install(windows.WindowAggregator(emit_every_s=0.0))
+    with obs.span("infer_batch", n=4):
+        pass
+    with obs.span("data_wait"):
+        pass
+    obs.close_run()
+    events, _ = load_events(run_dir)
+    sums = {e["metric"] for e in events if e["ev"] == "window_summary"}
+    assert "serving_ms" in sums and "data_wait_ms" in sums
+
+
+def test_span_hook_normalizes_fused_dispatch_per_step(tmp_path):
+    """A fused dispatch's data_wait span covers `take` steps at once; the
+    window sample must be per-step or data_wait_fraction reads k× too
+    high on healthy pipelined runs (step_ms is per-step by construction)."""
+    obs.init_run(str(tmp_path / "run"), process_index=0)
+    agg = windows.WindowAggregator(emit_every_s=1e9)
+    windows.install(agg)
+    with obs.span("data_wait", take=8):
+        pass
+    windows.observe_span("data_wait", 0.8, {"take": 8})
+    vals = agg._win["data_wait_ms"].values(now=agg._last_emit + 1)
+    assert vals[-1] == pytest.approx(100.0)  # 800ms / 8 steps
+    # take=1 (or absent) stays un-normalized; serving is per-batch.
+    windows.observe_span("data_wait", 0.2, {"take": 1})
+    assert agg._win["data_wait_ms"].values(
+        now=agg._last_emit + 1)[-1] == pytest.approx(200.0)
+    windows.observe_span("infer_batch", 0.4, {"n": 8})
+    assert agg._win["serving_ms"].values(
+        now=agg._last_emit + 1)[-1] == pytest.approx(400.0)
+    # The fraction the default alert judges is now k-invariant.
+    for _ in range(8):
+        agg.observe("step_ms", 100.0)
+    frac = agg.rule_value("data_wait_fraction", agg._last_emit + 1)
+    assert frac < 0.5  # ~(100+200+eps)/800
+
+
+def test_active_flag_ors_across_hosts():
+    """A rule still live on host 0 must not be masked by a
+    later-timestamped recovered firing on another host."""
+    def summary(t, h, seq):
+        return {"t": t, "ev": "window_summary", "metric": "step_ms",
+                "n": 4, "p50": 1.0, "p95": 1.0, "p99": 1.0, "mean": 1.0,
+                "max": 1.0, "seq": seq, "process_index": h}
+
+    def alert(t, h, window):
+        return {"t": t, "ev": "alert", "rule": "step_p99_ratio",
+                "severity": "warning", "value": 5.0, "threshold": 4.0,
+                "window": window, "process_index": h}
+
+    events = [
+        summary(1.0, 0, 3), alert(1.0, 0, 3),   # host 0: live at its latest
+        summary(2.0, 1, 3), alert(2.0, 1, 3),
+        summary(3.0, 1, 9),                     # host 1: recovered since
+    ]
+    rep = build_report(events)
+    assert rep["slo"]["alerts"]["step_p99_ratio"]["active"] is True
+    # Both hosts recovered -> inactive.
+    rep2 = build_report(events[2:] + [summary(4.0, 0, 9)])
+    assert rep2["slo"]["alerts"]["step_p99_ratio"]["active"] is False
+
+
+def test_init_run_switch_resets_windows(tmp_path):
+    """Switching run dirs must not leak run A's ring buffers/seq into run
+    B's first summary: A gets a final flushed cycle, B starts fresh."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    obs.init_run(a, process_index=0)
+    agg_a = windows.WindowAggregator(emit_every_s=1e9)
+    windows.install(agg_a)
+    for _ in range(5):
+        obs.observe("step_ms", 100.0)
+    obs.init_run(b, process_index=0)  # no close_run: the switching path
+    assert not windows.active() or windows._agg is not agg_a
+    obs.observe("step_ms", 7.0)
+    windows.flush()
+    obs.close_run()
+    ev_a, _ = load_events(a)
+    ev_b, _ = load_events(b)
+    sum_a = [e for e in ev_a if e["ev"] == "window_summary"]
+    sum_b = [e for e in ev_b if e["ev"] == "window_summary"]
+    assert sum_a and sum_a[-1]["n"] == 5  # A's samples flushed into A
+    assert sum_b and sum_b[-1]["n"] == 1  # B sees ONLY its own sample
+    assert sum_b[-1]["p50"] == 7.0 and sum_b[-1]["seq"] == 1
+
+
+def test_observe_without_aggregator_is_noop():
+    assert not windows.active()
+    obs.observe("step_ms", 1.0)  # no crash, no state
+    windows.observe_span("data_wait", 0.1)
+    windows.flush()
+
+
+# --- report SLO section / follow / trace -------------------------------------
+
+def _slo_events(t0=1000.0):
+    return [
+        {"t": t0, "ev": "loop_start", "step": 0, "stop": 4, "total": 4},
+        {"t": t0 + 0.1, "ev": "span", "name": "data_wait", "dur_s": 0.5},
+        {"t": t0 + 1.0, "ev": "window_summary", "metric": "step_ms",
+         "n": 4, "p50": 100.0, "p95": 120.0, "p99": 130.0, "mean": 105.0,
+         "max": 130.0, "seq": 1},
+        {"t": t0 + 1.0, "ev": "alert", "rule": "data_wait_fraction",
+         "severity": "warning", "value": 0.8, "threshold": 0.5, "window": 1},
+        {"t": t0 + 2.0, "ev": "window_summary", "metric": "step_ms",
+         "n": 8, "p50": 90.0, "p95": 95.0, "p99": 99.0, "mean": 91.0,
+         "max": 99.0, "seq": 2},
+        {"t": t0 + 2.5, "ev": "loop_end", "step": 4, "wall_s": 2.5},
+    ]
+
+
+def test_report_slo_section_counts_and_active_flag():
+    rep = build_report(_slo_events())
+    slo = rep["slo"]
+    # Latest window wins the display.
+    assert slo["windows"]["step_ms"]["p50"] == 90.0
+    assert slo["windows"]["step_ms"]["seq"] == 2
+    a = slo["alerts"]["data_wait_fraction"]
+    assert a["count"] == 1 and a["last_value"] == 0.8
+    # The alert fired at seq 1; the latest summary is seq 2 — recovered,
+    # so it must NOT read as live.
+    assert a["active"] is False
+    txt = format_report(rep)
+    assert "SLO windows" in txt and "step_ms" in txt
+    assert "fired  data_wait_fraction" in txt
+
+    # A second alert on the latest cycle IS active.
+    ev = _slo_events() + [
+        {"t": 1002.1, "ev": "alert", "rule": "data_wait_fraction",
+         "severity": "warning", "value": 0.9, "threshold": 0.5, "window": 2},
+    ]
+    rep2 = build_report(ev)
+    a2 = rep2["slo"]["alerts"]["data_wait_fraction"]
+    assert a2["count"] == 2 and a2["active"] is True
+    assert "ACTIVE data_wait_fraction" in format_report(rep2)
+    line = follow_slo_line(rep2)
+    assert line.startswith("== slo |")
+    assert "step_ms p50 90.0/p99 99.0" in line
+    assert "ALERTS: data_wait_fraction" in line
+    # No SLO telemetry -> no line (the follow header stays single).
+    assert follow_slo_line(build_report(_slo_events()[:2])) is None
+
+
+def test_report_side_cross_host_spread_alert():
+    """The one rule no single process can judge: cross-host data-wait
+    spread, evaluated where the streams merge (default threshold 0.25)."""
+    def host(idx, dw):
+        t0 = 1000.0 + idx * 0.1
+        return [
+            {"t": t0, "ev": "loop_start", "step": 0, "stop": 4, "total": 4,
+             "process_index": idx},
+            {"t": t0 + 0.1, "ev": "span", "name": "data_wait", "dur_s": dw,
+             "process_index": idx},
+            {"t": t0 + 2.0, "ev": "loop_end", "step": 4, "wall_s": 2.0,
+             "process_index": idx},
+        ]
+
+    events = host(0, 0.2) + host(1, 1.2)  # fractions 10% vs 60%
+    rep = build_report(sorted(events, key=lambda e: e["t"]))
+    spread = rep["host_skew"]["data_wait_fraction"]["spread"]
+    assert spread == pytest.approx(0.5)
+    a = rep["slo"]["alerts"]["data_wait_spread"]
+    assert a["active"] and a["source"] == "report"
+    assert a["last_value"] == pytest.approx(0.5)
+    # ... and the spread is a gateable scalar (ROADMAP obs-next item).
+    from featurenet_tpu.obs.gates import evaluate_gates, report_gate_values
+
+    vals = report_gate_values(rep)
+    assert vals["data_wait_spread"] == pytest.approx(0.5)
+    base = {"gates": {"data_wait_spread": {"value": 0.1, "tolerance": 0.1}}}
+    res = evaluate_gates(vals, base)
+    assert not res["ok"] and res["failed"] == ["data_wait_spread"]
+
+    # A tight mesh (10% vs 12%) stays quiet.
+    calm = host(0, 0.2) + host(1, 0.24)
+    rep2 = build_report(sorted(calm, key=lambda e: e["t"]))
+    assert "data_wait_spread" not in (rep2.get("slo") or {}).get("alerts", {})
+
+
+def test_chrome_trace_exports_windows_as_counter_tracks():
+    from featurenet_tpu.obs.spans import chrome_trace
+
+    trace = chrome_trace(_slo_events())
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    assert len(counters) == 2
+    assert counters[0]["name"] == "window step_ms"
+    assert counters[0]["args"] == {"p50": 100.0, "p95": 120.0, "p99": 130.0}
+    assert all(c["ts"] >= 0 for c in counters)
+    # Counter-only logs still export (no spans required).
+    only = [e for e in _slo_events() if e["ev"] == "window_summary"]
+    assert [e["ph"] for e in chrome_trace(only)["traceEvents"]
+            if e["ph"] == "C"]
+
+
+# --- latency fault injection (producer_slow / save_slow) ---------------------
+
+def test_producer_slow_injects_latency_not_death(monkeypatch):
+    import time as _time
+
+    from featurenet_tpu.data.dataset import (
+        SyntheticVoxelDataset,
+        prefetch_to_device,
+    )
+
+    monkeypatch.setattr(faults, "SLOW_SLEEP_S", 0.2)
+    faults.install("producer_slow@batch=0")
+    ds = SyntheticVoxelDataset(resolution=16, global_batch=4)
+    t0 = _time.perf_counter()
+    it = prefetch_to_device(ds, num_workers=1)
+    batch = next(it)
+    assert _time.perf_counter() - t0 >= 0.2  # slept, then produced
+    assert batch["voxels"].shape[0] == 4  # the batch still arrives
+    it.close()
+
+
+def test_save_slow_injects_latency_into_the_save_span(tmp_path, monkeypatch):
+    import time as _time
+
+    monkeypatch.setattr(faults, "SLOW_SLEEP_S", 0.2)
+    faults.install("save_slow@save=1")
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    cfg = get_config(
+        "smoke16", total_steps=1, log_every=10**9, eval_every=10**9,
+        checkpoint_every=1, eval_batches=1, data_workers=1, global_batch=8,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    t = Trainer(cfg)
+    t0 = _time.perf_counter()
+    t.ckpt.save(t.state)
+    assert _time.perf_counter() - t0 >= 0.2
+    t.ckpt.wait()
+    t.ckpt.close()
+    obs.close_run()
+    events, _ = load_events(run_dir)
+    saves = [e for e in events
+             if e["ev"] == "span" and e["name"] == "checkpoint_save"]
+    # The sleep happened INSIDE the span: the slowness is attributed.
+    assert saves and saves[0]["dur_s"] >= 0.2
+
+
+def test_latency_sites_in_dsl_and_registry():
+    parsed = faults.parse_spec("producer_slow@batch=8:every=4,save_slow")
+    assert parsed["producer_slow"] == ("batch", 8, 4)
+    assert parsed["save_slow"] is None
+    assert faults.SITES["producer_slow"] == "batch"
+    assert faults.SITES["save_slow"] == "save"
+
+
+# --- acceptance: producer_slow run fires the data-wait alert e2e -------------
+
+def test_e2e_producer_slow_fires_data_wait_alert(tmp_path, capsys):
+    """Satellite 4 / acceptance: a run with producer_slow injected emits
+    window_summary events and fires a data-wait alert that shows in the
+    report's SLO section AND in --follow — tier-1, CPU, synthetic data."""
+    run_dir = str(tmp_path / "run")
+    cfg = get_config(
+        "smoke16", total_steps=2, log_every=10**9, eval_every=10**9,
+        checkpoint_every=10**9, eval_batches=1, data_workers=1,
+        global_batch=8, run_dir=run_dir,
+        inject_faults="producer_slow@batch=0:every=1",
+        # max, not p50: the prefetcher legitimately hides most of the
+        # injected latency behind the first compile (only some pops block),
+        # and the window's MAX is what a sustained drag can't dodge.
+        alert_rules="data_wait_ms_max>50:critical",
+    )
+    t = Trainer(cfg)
+    t.run()
+    obs.close_run()
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    sums = [e for e in events if e["ev"] == "window_summary"]
+    assert any(e["metric"] == "data_wait_ms" for e in sums)
+    fired = [e for e in events if e["ev"] == "alert"]
+    assert any(
+        e["rule"] == "data_wait_ms_max" and e["severity"] == "critical"
+        and e["value"] > 50 for e in fired
+    )
+    # The schema lint knows the new kinds (satellite 6).
+    assert validate_events(events, bad_lines=bad) == []
+
+    from featurenet_tpu.cli import main as cli_main
+
+    cli_main(["report", run_dir])
+    out = capsys.readouterr().out
+    assert "SLO windows" in out
+    assert "data_wait_ms_max" in out and "critical" in out
+    cli_main(["report", run_dir, "--validate"])
+    assert '"validate": "ok"' in capsys.readouterr().out
+
+    # --follow renders the percentiles + the alert under its header.
+    outputs: list = []
+    follow_report(run_dir, interval=0.01, out=outputs.append,
+                  clock=lambda s: None, max_polls=1, clear=False)
+    head_lines = outputs[0].splitlines()
+    assert head_lines[1].startswith("== slo |")
+    assert "data_wait_ms" in head_lines[1]
+    assert "ALERTS: data_wait_ms_max" in head_lines[1]
+
+
+# --- supervisor self-pinning gates -------------------------------------------
+
+def _loop_stream(t0, step_ms):
+    dur = step_ms / 1e3
+    return [
+        {"t": t0, "ev": "loop_start", "step": 0, "stop": 4, "total": 4},
+        {"t": t0 + 0.1, "ev": "span", "name": "data_wait",
+         "dur_s": dur},
+        {"t": t0 + 4 * dur, "ev": "loop_end", "step": 4,
+         "wall_s": 4 * dur},
+    ]
+
+
+def test_gate_segment_pins_then_flags_regression(tmp_path):
+    from featurenet_tpu.train.supervisor import (
+        GATE_BASELINE_FILENAME,
+        _gate_segment,
+        segment_gate_values,
+    )
+
+    run_dir = str(tmp_path)
+    path = os.path.join(run_dir, "events.jsonl")
+    with open(path, "w") as fh:
+        for e in _loop_stream(1000.0, step_ms=100.0):
+            fh.write(json.dumps(e) + "\n")
+    seg1_end = os.path.getsize(path)
+
+    records: list = []
+    logs: list = []
+
+    def record(phase, **fields):
+        records.append((phase, fields))
+
+    # Segment 1 (offsets {}): no baseline yet -> auto-pin.
+    vals = segment_gate_values(run_dir, {})
+    assert vals["step_ms"] == pytest.approx(100.0)
+    assert "restarts" not in vals  # supervisor-cumulative: never pinned
+    _gate_segment(run_dir, {}, record, logs.append)
+    pin_path = os.path.join(run_dir, GATE_BASELINE_FILENAME)
+    assert os.path.exists(pin_path)
+    assert records[-1][0] == "auto_pin"
+    pinned = json.load(open(pin_path))
+    assert pinned["gates"]["step_ms"]["value"] == pytest.approx(100.0)
+
+    # Segment 2, steady: gate passes.
+    with open(path, "a") as fh:
+        for e in _loop_stream(2000.0, step_ms=110.0):
+            fh.write(json.dumps(e) + "\n")
+    _gate_segment(run_dir, {path: seg1_end}, record, logs.append)
+    assert records[-1][0] == "gate" and records[-1][1]["ok"] is True
+    seg2_end = os.path.getsize(path)
+
+    # Segment 3, 5x slower: gate_regression — alert, never a verdict.
+    with open(path, "a") as fh:
+        for e in _loop_stream(3000.0, step_ms=500.0):
+            fh.write(json.dumps(e) + "\n")
+    _gate_segment(run_dir, {path: seg2_end}, record, logs.append)
+    phase, fields = records[-1]
+    assert phase == "gate_regression"
+    assert "step_ms" in fields["failed"]
+    assert fields["values"]["step_ms"] == pytest.approx(500.0)
+    assert any('"gate_regression"' in line for line in logs)
+
+
+def test_gate_segment_never_load_bearing(tmp_path):
+    """A garbled baseline degrades to a gate_error log line — the judge
+    must never kill (or restart) the run it judges."""
+    from featurenet_tpu.train.supervisor import (
+        GATE_BASELINE_FILENAME,
+        _gate_segment,
+    )
+
+    run_dir = str(tmp_path)
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as fh:
+        for e in _loop_stream(1000.0, step_ms=100.0):
+            fh.write(json.dumps(e) + "\n")
+    with open(os.path.join(run_dir, GATE_BASELINE_FILENAME), "w") as fh:
+        fh.write("{not json")
+    logs: list = []
+    _gate_segment(run_dir, {}, lambda *a, **k: None, logs.append)
+    assert any("gate_error" in line for line in logs)
+    # And a segment with no loop (nothing to judge) is silently skipped.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with open(os.path.join(str(empty), "events.jsonl"), "w") as fh:
+        fh.write(json.dumps({"t": 1.0, "ev": "heartbeat"}) + "\n")
+    _gate_segment(str(empty), {}, lambda *a, **k: None, logs.append)
+    assert not os.path.exists(
+        os.path.join(str(empty), GATE_BASELINE_FILENAME)
+    )
+
+
+_CHILD = """
+import json, sys
+from featurenet_tpu.config import get_config
+from featurenet_tpu.train.loop import Trainer
+over = json.loads(sys.argv[1])
+Trainer(get_config("smoke16", **over)).run()
+"""
+
+
+def test_e2e_supervised_two_segments_auto_pin_and_gate(tmp_path):
+    """Acceptance: a supervised 2-segment run (restart_every_steps=1,
+    total 2) auto-pins its baseline after segment 1 (the planned-restart
+    exit) and gates segment 2 against it at the done exit."""
+    from featurenet_tpu.train.supervisor import (
+        GATE_BASELINE_FILENAME,
+        supervise,
+    )
+
+    hb = str(tmp_path / "hb")
+    run_dir = str(tmp_path / "run")
+    over = dict(
+        total_steps=2,
+        restart_every_steps=1,
+        global_batch=8,
+        data_workers=1,
+        eval_batches=1,
+        log_every=10**9,
+        eval_every=10**9,
+        checkpoint_every=10**9,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        run_dir=run_dir,
+        heartbeat_file=hb,
+    )
+    env_patch = {
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    }
+    old = {k: os.environ.get(k) for k in env_patch}
+    os.environ.update(env_patch)
+    records: list = []
+    try:
+        res = supervise(
+            [sys.executable, "-c", _CHILD, json.dumps(over)],
+            heartbeat_file=hb,
+            stall_timeout_s=120,
+            grace_s=600,
+            max_restarts=2,
+            poll_s=0.2,
+            backoff_base_s=0.05,
+            log=lambda s: records.append(json.loads(s)),
+            run_dir=run_dir,
+        )
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert res.exit_code == 0
+    assert res.planned == 1 and res.restarts == 0
+    # Segment 1 pinned the baseline...
+    assert os.path.exists(os.path.join(run_dir, GATE_BASELINE_FILENAME))
+    with open(os.path.join(run_dir, "events.jsonl")) as fh:
+        events = [json.loads(line) for line in fh]
+    phases = [e.get("phase") for e in events if e["ev"] == "supervisor"]
+    assert "auto_pin" in phases
+    # ...and segment 2 was judged against it (either verdict is a judged
+    # segment; regression on a noisy CI box is an alert, not a failure).
+    assert "gate" in phases or "gate_regression" in phases
+    assert phases.index("auto_pin") < len(phases) - 1
+    # The pin precedes the planned_restart record (first clean segment).
+    assert phases.index("auto_pin") < phases.index("planned_restart")
+    # The run itself completed its budget.
+    assert any(e["ev"] == "run_end" and e["step"] == 2 for e in events)
+    # The report folds it all: supervisor section + gate counters.
+    rep = build_report(sorted(events, key=lambda e: e["t"]))
+    assert rep["supervisor"]["planned_restarts"] == 1
+    assert "gate_regressions" in rep["supervisor"]
+
+
+# --- bench gate-summary wiring -----------------------------------------------
+
+def test_bench_window_gate_fields_and_keys(tmp_path):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    from featurenet_tpu.obs import gates
+
+    run_dir = str(tmp_path)
+    rows = [
+        {"t": 1.0, "ev": "window_summary", "metric": "data_wait_ms",
+         "n": 8, "p50": 2.0, "p95": 4.0, "p99": 5.0, "mean": 2.5,
+         "max": 5.0, "seq": 1},
+        {"t": 2.0, "ev": "window_summary", "metric": "data_wait_ms",
+         "n": 16, "p50": 3.0, "p95": 6.0, "p99": 7.0, "mean": 3.5,
+         "max": 7.0, "seq": 2},
+        {"t": 2.0, "ev": "window_summary", "metric": "queue_depth",
+         "n": 16, "p50": 2.0, "p95": 2.0, "p99": 2.0, "mean": 2.0,
+         "max": 2.0, "seq": 2},
+    ]
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    fields = bench._window_gate_fields(run_dir)
+    assert fields == {
+        "window_data_wait_p50_ms": 3.0,  # the LAST window wins
+        "window_data_wait_p99_ms": 7.0,
+        "window_queue_depth_p50": 2.0,
+    }
+    # Missing dir degrades to no fields, never an exception.
+    assert bench._window_gate_fields(str(tmp_path / "nope")) == {}
+
+    # The new keys are pinnable and directed: window latencies regress
+    # upward, queue depth regresses DOWNWARD (starvation reads low), and
+    # the spread keys are pinned too (satellite 6).
+    summary = {"value": 16000.0, "spread_pct": 3.8,
+               "serving_spread_pct": 1.9, **fields}
+    vals = gates.bench_gate_values(summary)
+    assert {"spread_pct", "serving_spread_pct", "window_data_wait_p50_ms",
+            "window_queue_depth_p50"} <= set(vals)
+    pin = gates.make_baseline(vals, tolerance=0.15)
+    assert pin["gates"]["window_queue_depth_p50"]["direction"] == "min"
+    assert pin["gates"]["window_data_wait_p99_ms"]["direction"] == "max"
+    assert pin["gates"]["spread_pct"]["direction"] == "max"
+    # A starved next round (depth collapses to 0) fails the pin.
+    starved = dict(summary, window_queue_depth_p50=0.0)
+    res = gates.evaluate_gates(gates.bench_gate_values(starved), pin)
+    assert not res["ok"] and "window_queue_depth_p50" in res["failed"]
