@@ -14,6 +14,7 @@ linter stays runnable where no ML stack exists.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Optional
 
 from featurenet_tpu.analysis.lint import Finding, Module, Tree, register
@@ -444,10 +445,11 @@ def _config_fields(mod: Module) -> dict[str, int]:
     return {}
 
 
-def _cli_flags(mod: Module) -> list[tuple[str, str, int]]:
-    """(flag, dest, line) for every long-option add_argument in the shared
-    override/supervise flag builders."""
-    flags: list[tuple[str, str, int]] = []
+def _cli_flags(mod: Module) -> list[tuple[str, str, int, Optional[tuple]]]:
+    """(flag, dest, line, choices) for every long-option add_argument in
+    the shared override/supervise flag builders; ``choices`` is the
+    literal ``choices=[...]`` tuple when present, else None."""
+    flags: list[tuple[str, str, int, Optional[tuple]]] = []
     for node in ast.walk(mod.tree):
         if not (isinstance(node, ast.FunctionDef)
                 and node.name in _FLAG_FUNCTIONS):
@@ -461,13 +463,52 @@ def _cli_flags(mod: Module) -> list[tuple[str, str, int]]:
             if not flag or not flag.startswith("--"):
                 continue
             dest = None
+            choices = None
             for kw in call.keywords:
                 if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
                     dest = kw.value.value
+                elif kw.arg == "choices" and isinstance(
+                        kw.value, (ast.List, ast.Tuple)):
+                    choices = tuple(
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                    )
             if dest is None:
                 dest = flag[2:].replace("-", "_")
-            flags.append((flag, dest, call.lineno))
+            flags.append((flag, dest, call.lineno, choices))
     return flags
+
+
+def _validate_sets(cfg_mod: Module) -> dict[str, tuple[set, int]]:
+    """Field -> (accepted literal set, line) for every membership refusal
+    in ``Config.validate()`` — the ``self.X not in ("a", "b")`` guards the
+    CLI's ``choices=`` lists must agree with."""
+    out: dict[str, tuple[set, int]] = {}
+    for node in ast.walk(cfg_mod.tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == "Config"):
+            continue
+        for fn in node.body:
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name == "validate"):
+                continue
+            for cmp in ast.walk(fn):
+                if not (isinstance(cmp, ast.Compare)
+                        and len(cmp.ops) == 1
+                        and isinstance(cmp.ops[0], ast.NotIn)
+                        and isinstance(cmp.left, ast.Attribute)
+                        and isinstance(cmp.left.value, ast.Name)
+                        and cmp.left.value.id == "self"
+                        and isinstance(cmp.comparators[0],
+                                       (ast.Tuple, ast.List, ast.Set))):
+                    continue
+                values = {
+                    e.value for e in cmp.comparators[0].elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                }
+                if values:
+                    out[cmp.left.attr] = (values, cmp.lineno)
+    return out
 
 
 def _override_keys(mod: Module) -> tuple[list[str], int]:
@@ -503,8 +544,8 @@ def config_cli_rule(tree: Tree) -> list[Finding]:
         return []
     findings: list[Finding] = []
     flags = _cli_flags(cli_mod)
-    dests = {d for _, d, _ in flags}
-    for flag, dest, line in flags:
+    dests = {d for _, d, _, _ in flags}
+    for flag, dest, line, _ in flags:
         if dest in fields or dest in FLAG_ALIASES:
             continue
         findings.append(Finding(
@@ -513,6 +554,32 @@ def config_cli_rule(tree: Tree) -> list[Finding]:
             "has no FLAG_ALIASES entry — the override would be dropped "
             "on the floor",
         ))
+    # choices= lists vs validate()'s accepted sets (ROADMAP item 5 lint
+    # follow-on): a flag narrowing to a different set than the config
+    # refuses — or a restricted field whose flag doesn't narrow at all —
+    # lets a value parse on one surface and explode (or pass) on the
+    # other.
+    accepted = _validate_sets(cfg_mod)
+    for flag, dest, line, choices in flags:
+        if dest not in fields:
+            continue  # aliased flags narrow arch subfields, not Config
+        acc = accepted.get(dest)
+        if choices is not None and acc is not None \
+                and set(choices) != acc[0]:
+            findings.append(Finding(
+                "config-cli", "choices_drift", cli_mod.path, line,
+                f"CLI flag {flag} offers choices {sorted(choices)} but "
+                f"Config.validate() accepts {sorted(acc[0])} "
+                f"(config.py:{acc[1]}) — the two surfaces drifted",
+            ))
+        elif choices is None and acc is not None:
+            findings.append(Finding(
+                "config-cli", "missing_choices", cli_mod.path, line,
+                f"CLI flag {flag} has no choices= but Config.validate() "
+                f"restricts {dest!r} to {sorted(acc[0])} — an invalid "
+                "value would parse and only explode at validate time; "
+                "mirror the accepted set",
+            ))
     keys, keys_line = _override_keys(cli_mod)
     for key in keys:
         if key not in fields:
@@ -599,4 +666,63 @@ def span_names_rule(tree: Tree) -> list[Finding]:
                 "call site emits it — its step-time breakdown row would "
                 "always read zero (dead category)",
             ))
+    return findings
+
+
+# --- rule 7: alert-rule fragments in docs/help vs known_metrics --------------
+
+# An alert-DSL fragment: metric OP number [":" severity], with NO
+# whitespace around the operator (prose like "augment_groups > 0" is not a
+# rule example).
+_ALERT_FRAGMENT = re.compile(
+    r"(?<![A-Za-z0-9_.])([a-z][a-z0-9_]{2,})([<>])"
+    r"[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?(?::([a-z]+))?"
+)
+
+
+@register("alerts")
+def alert_docs_rule(tree: Tree) -> list[Finding]:
+    """Alert-rule examples in docstrings/help text vs the live metric
+    universe (``obs.alerts.known_metrics()``) — ROADMAP item 5's last
+    lint follow-on. A doc example naming a metric the parser would refuse
+    (or a severity outside ``SEVERITIES``) teaches operators a spec that
+    fails at config time; a RENAMED metric leaves every doc stale the
+    moment the rename lands. Suppress a deliberate non-example with
+    ``# lint: allow-alert-doc(<reason>)``."""
+    from featurenet_tpu.obs.alerts import SEVERITIES, known_metrics
+
+    valid = known_metrics()
+    findings: list[Finding] = []
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            for m in _ALERT_FRAGMENT.finditer(node.value):
+                metric, severity = m.group(1), m.group(3)
+                # Anchor the finding to the fragment's own line inside a
+                # multi-line string (node.lineno is the opening quote).
+                # Suppressions are honored at either anchor: a comment
+                # cannot live INSIDE a docstring, so the opening-quote
+                # line stays the escape hatch for those.
+                line = node.lineno + node.value.count("\n", 0, m.start())
+                if (mod.suppressed(line, "alert-doc")
+                        or mod.suppressed(node.lineno, "alert-doc")):
+                    continue
+                if metric not in valid:
+                    findings.append(Finding(
+                        "alerts", "unknown_doc_metric", mod.path,
+                        line,
+                        f"alert-rule example {m.group(0)!r} names metric "
+                        f"{metric!r}, which alerts.known_metrics() does "
+                        "not know — the documented spec would be refused "
+                        "at config time",
+                    ))
+                elif severity is not None and severity not in SEVERITIES:
+                    findings.append(Finding(
+                        "alerts", "unknown_doc_severity", mod.path,
+                        line,
+                        f"alert-rule example {m.group(0)!r} uses severity "
+                        f"{severity!r}; one of {', '.join(SEVERITIES)}",
+                    ))
     return findings

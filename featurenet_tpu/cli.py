@@ -46,6 +46,13 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "run.json + events.jsonl (spans, gauges, metrics, "
                         "warnings, heartbeats, supervisor restarts); "
                         "analyze with `cli report <run_dir>`")
+    p.add_argument("--exec-cache-dir", dest="exec_cache_dir",
+                   help="persistent AOT executable cache directory "
+                        "(featurenet_tpu.runtime): compiled programs are "
+                        "serialized here and respawns/resumes/cold starts "
+                        "deserialize instead of recompiling; loads are "
+                        "probe-guarded and degrade to a fresh compile "
+                        "with a cache_reject event on any failure")
     p.add_argument("--no-augment", action="store_true",
                    help="disable train-time pose augmentation (cache-backed)")
     p.add_argument("--augment-affine", action="store_true",
@@ -167,7 +174,7 @@ def _overrides(args) -> dict:
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
         "augment_translate_vox", "init_from", "inject_faults",
-        "alert_rules",
+        "alert_rules", "exec_cache_dir",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
@@ -248,7 +255,7 @@ def _cfg_from_checkpoint(saved, args):
     # unsupervised resume inheriting it from the sidecar would die with
     # exit 75 mid-run and nothing would respawn it.
     for k in ("heartbeat_file", "profile_dir", "tb_dir", "run_dir",
-              "restart_every_steps", "inject_faults"):
+              "restart_every_steps", "inject_faults", "exec_cache_dir"):
         over.setdefault(k, None)
     # Arch flags must reach the returned config too — check_identity above
     # already rejected real contradictions, so what flows through here is
@@ -381,6 +388,28 @@ def main(argv=None) -> None:
     p_bld.add_argument("--run-dir", dest="run_dir",
                        help="observability directory: record per-class "
                             "ingest spans (see `cli report`)")
+    p_prog = sub.add_parser("programs", allow_abbrev=False,
+                            help="enumerate the runtime registry's "
+                                 "compiled programs for a config "
+                                 "(featurenet_tpu.runtime): name, "
+                                 "precision, applicability; --warm builds "
+                                 "them AOT (and populates "
+                                 "--exec-cache-dir when set)")
+    p_prog.add_argument("--config", default="pod64",
+                        help="preset whose program catalog to list "
+                             "(default pod64)")
+    p_prog.add_argument("--warm", action="store_true",
+                        help="build every applicable program (AOT warmup; "
+                             "with --exec-cache-dir, populates the "
+                             "persistent executable cache for later "
+                             "respawns/cold starts)")
+    p_prog.add_argument("--exec-cache-dir", dest="exec_cache_dir",
+                        help="persistent executable cache directory the "
+                             "warmup builds into / loads from")
+    p_prog.add_argument("--run-dir", dest="run_dir",
+                        help="observability directory: record "
+                             "program_compile/cache_* events of the "
+                             "warmup (see `cli report`)")
     p_lint = sub.add_parser("lint", allow_abbrev=False,
                             help="repo-native static analysis "
                                  "(featurenet_tpu.analysis): enforce the "
@@ -402,7 +431,7 @@ def main(argv=None) -> None:
                         metavar="NAME",
                         help="run only this rule family (repeatable): "
                              "telemetry, fault-sites, host-sync, hygiene, "
-                             "config-cli, spans")
+                             "config-cli, spans, alerts")
     p_rep = sub.add_parser("report", allow_abbrev=False,
                            help="analyze a run directory's observability "
                                 "log (featurenet_tpu.obs): step-time "
@@ -455,6 +484,13 @@ def main(argv=None) -> None:
     p_inf.add_argument("--conv-backend", choices=["xla", "pallas", "hybrid_dw"],
                        help="legacy checkpoints trained with a non-default "
                             "conv backend")
+    p_inf.add_argument("--precision", choices=["fp32", "int8"],
+                       default="fp32",
+                       help="serving weight precision: int8 runs the "
+                            "per-channel post-training-quantized program "
+                            "(featurenet_tpu.runtime.quantize; 4x less "
+                            "weight HBM traffic, accuracy-gated in tests "
+                            "against the paper's 96.7%% target)")
     p_inf.add_argument("--seg-out",
                        help="segment checkpoints: also write each part's "
                             "per-voxel label grid to this directory as "
@@ -463,6 +499,36 @@ def main(argv=None) -> None:
                        help="observability directory: record per-batch "
                             "serving latency spans (see `cli report`)")
     args = parser.parse_args(argv)
+
+    if args.cmd == "programs":
+        # The registry's enumeration surface: list what a config compiles
+        # (no backend work), or --warm to build it all AOT — the same path
+        # `infer` warms its serving program through at startup.
+        from featurenet_tpu.config import get_config
+        from featurenet_tpu.runtime import list_programs
+
+        cfg = get_config(args.config, **(
+            {"exec_cache_dir": args.exec_cache_dir}
+            if args.exec_cache_dir else {}
+        ))
+        if args.run_dir:
+            from featurenet_tpu import obs
+            from featurenet_tpu.config import config_to_dict
+
+            obs.init_run(args.run_dir, config=config_to_dict(cfg),
+                         extra={"cmd": "programs"})
+        for row in list_programs(cfg):
+            print(json.dumps(row))
+        if args.warm:
+            from featurenet_tpu.runtime import Runtime
+
+            built = Runtime(cfg).warmup()
+            print(json.dumps({"warmup": built}))
+        if args.run_dir:
+            from featurenet_tpu import obs
+
+            obs.close_run()
+        return
 
     if args.cmd == "lint":
         # Static analysis of the package itself: stdlib + ast only, no
@@ -884,9 +950,12 @@ def main(argv=None) -> None:
             obs.init_run(args.run_dir, config=config_to_dict(cfg))
         # Compile batch sized to the request: padding 1 STL to the default
         # 32 would run 32x the needed FLOPs (felt hardest by the
-        # full-resolution segmentation decoder).
+        # full-resolution segmentation decoder). Construction is the AOT
+        # warmup: the serving program builds (or loads from the exec
+        # cache) before the first STL is voxelized.
         pred = Predictor.from_checkpoint(
-            args.checkpoint_dir, cfg, batch=min(32, len(args.stl))
+            args.checkpoint_dir, cfg, batch=min(32, len(args.stl)),
+            precision=args.precision,
         )
         if args.seg_out:
             os.makedirs(args.seg_out, exist_ok=True)

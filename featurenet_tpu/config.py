@@ -189,6 +189,17 @@ class Config:
     # and `--follow` — and are never load-bearing. Only meaningful with
     # run_dir (no sink, no windows).
     alert_rules: Optional[str] = None
+    # Persistent AOT executable cache (featurenet_tpu.runtime.cache): when
+    # set, every compiled program the runtime registry builds — train
+    # steps, eval, serving forwards — is serialized into this directory
+    # and later processes (supervisor respawns, preemption resumes,
+    # serving cold starts) deserialize instead of re-paying XLA
+    # compilation. Loads are guarded (probe-in-subprocess; see the cache
+    # module's sandbox-abort hazard note) and any failure degrades to a
+    # fresh compile with a cache_reject event. None (default) = no
+    # serialization, no deserialization, anywhere. The
+    # FEATURENET_EXEC_CACHE_DIR env var supplies a fleet-wide default.
+    exec_cache_dir: Optional[str] = None
     # Liveness: when set, the Trainer touches this file at every confirmed
     # point of progress (a device readback, an eval, a checkpoint). A
     # supervisor (train.supervisor / `cli train --supervise`) watches the

@@ -52,39 +52,80 @@ class SegPrediction:
 
 
 class Predictor:
-    """Fixed-shape compiled classifier forward over a trained checkpoint.
+    """Fixed-shape compiled serving forward over a trained checkpoint.
 
     ``batch`` is the static compile shape; inputs are padded up / chunked to
     it. Single-device by design (serving a ~5M-param model never needs a
-    mesh); the params live wherever ``jax.jit`` places them.
+    mesh). The forward is a runtime-registry program (``serve`` /
+    ``serve_int8``), built AOT at construction — that build IS the serving
+    warmup: with ``Config.exec_cache_dir`` set, a cold start deserializes
+    the executable from the persistent cache instead of recompiling, and
+    the first request never pays XLA.
+
+    ``precision="int8"`` serves per-channel weight-quantized int8 weights
+    (``runtime.quantize``): 4x less weight HBM traffic, dequantized on
+    device inside the program. Gate it with ``int8_agreement()`` — the
+    CPU-testable stand-in for the held-out accuracy target.
     """
 
-    def __init__(self, params, batch_stats, cfg: Config, batch: int = 32):
+    def __init__(self, params, batch_stats, cfg: Config, batch: int = 32,
+                 precision: str = "fp32"):
+        from featurenet_tpu.runtime import Runtime
+        from featurenet_tpu.runtime.registry import PRECISIONS
+
         import jax
 
-        from featurenet_tpu.train.loop import build_model
-
+        if precision not in PRECISIONS:
+            raise ValueError(
+                f"unknown serving precision {precision!r}; one of "
+                f"{', '.join(PRECISIONS)}"
+            )
         self.cfg = cfg
         self.batch = batch
-        self.model = build_model(cfg)
-        self._params = params
-        self._stats = batch_stats
+        self.precision = precision
+        # Single-device by design (a ~5M-param model never needs a serving
+        # mesh), so the Runtime gets an explicit 1x1 mesh: a checkpoint
+        # trained with a pod-scale mesh_data/mesh_model must restore and
+        # serve on a one-device host instead of dying in make_mesh, and
+        # the serve programs' cache fingerprints stay mesh-independent
+        # across serving fleets.
+        from featurenet_tpu.parallel.mesh import make_mesh
 
-        def forward(params, batch_stats, voxels):
-            logits = self.model.apply(
-                {"params": params, "batch_stats": batch_stats},
-                voxels,
-                train=False,
+        dev = jax.devices()[0]
+        self.rt = Runtime(cfg, mesh=make_mesh(1, 1, devices=[dev]))
+        self.model = self.rt.model
+        # Weights handed over from a mesh-sharded Trainer state are
+        # gathered onto the serving device here.
+        self._params = jax.device_put(params, dev)
+        self._stats = jax.device_put(batch_stats, dev)
+        if precision == "int8":
+            from featurenet_tpu.runtime.quantize import quantize_tree
+
+            # Quantize once at construction; the program dequantizes on
+            # device, so int8 is what sits in serving HBM.
+            self._qparams, self._scales = quantize_tree(self._params)
+            self._program = self.rt.build("serve_int8", batch=batch)
+        else:
+            self._program = self.rt.build("serve", batch=batch)
+
+    def _forward(self, voxels):
+        if self.precision == "int8":
+            return self._program(
+                self._qparams, self._scales, self._stats, voxels
             )
-            if cfg.task == "segment":
-                # Argmax on device: int8 labels cross the link, not the
-                # (num_classes+1)-channel fp32 probability volume.
-                return jax.numpy.argmax(logits, axis=-1).astype(
-                    jax.numpy.int8
-                )
-            return jax.nn.softmax(logits, axis=-1)
+        return self._program(self._params, self._stats, voxels)
 
-        self._forward = jax.jit(forward)
+    def int8_agreement(self, n: int = 48, seed: int = 0) -> float:
+        """Top-1 agreement between the fp32 and int8 forwards on fresh
+        synthetic parts — the serving-side accuracy gate (a prediction the
+        quantizer did not flip cannot have moved held-out accuracy)."""
+        from featurenet_tpu.data.synthetic import generate_batch
+        from featurenet_tpu.runtime.quantize import agreement
+
+        grids = generate_batch(
+            np.random.default_rng(seed), n, self.cfg.resolution
+        )["voxels"]
+        return agreement(self.model, self._params, self._stats, grids)
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -93,6 +134,7 @@ class Predictor:
         checkpoint_dir: str,
         config: Config | str | None = None,
         batch: int = 32,
+        precision: str = "fp32",
     ) -> "Predictor":
         """Restore params/batch_stats from an Orbax run directory.
 
@@ -109,12 +151,12 @@ class Predictor:
         import jax
 
         from featurenet_tpu.config import check_identity
+        from featurenet_tpu.runtime import build_model
         from featurenet_tpu.train.checkpoint import (
             CheckpointManager,
             load_run_config,
         )
         from featurenet_tpu.train.state import create_state
-        from featurenet_tpu.train.loop import build_model
         from featurenet_tpu.train.steps import make_optimizer
 
         saved = load_run_config(checkpoint_dir)
@@ -134,7 +176,8 @@ class Predictor:
         mgr = CheckpointManager(checkpoint_dir)
         state = mgr.restore(state)
         mgr.close()
-        return cls(state.params, state.batch_stats, cfg, batch=batch)
+        return cls(state.params, state.batch_stats, cfg, batch=batch,
+                   precision=precision)
 
     # -- prediction ---------------------------------------------------------
     def predict_voxels(
@@ -225,9 +268,7 @@ class Predictor:
             with obs.span("infer_batch", n=self.batch - pad,
                           batch=self.batch):
                 # lint: allow-host-sync(readback IS the measured latency)
-                y = np.asarray(
-                    self._forward(self._params, self._stats, chunk)
-                )
+                y = np.asarray(self._forward(chunk))
             out.append(y[: self.batch - pad])
         return np.concatenate(out, axis=0)
 

@@ -6,12 +6,16 @@ over a window-derived metric::
 
     data_wait_fraction>0.5:warning
 
-evaluated every time the aggregator emits its ``window_summary`` events;
-a violated rule fires one structured ``alert`` event per emission cycle
-(``rule`` / ``severity`` / ``value`` / ``threshold`` / ``window``). The
-emission cadence bounds the alert rate, and alerts are *never*
-load-bearing — the engine only ever writes telemetry, and the sink it
-writes through already degrades to a no-op on ENOSPC.
+evaluated every time the aggregator emits its ``window_summary`` events.
+Alerts are HYSTERETIC fire/resolve pairs: a rule crossing into violation
+fires ONE ``alert`` event with ``state="fire"`` and then stays silent —
+however many emission cycles the violation lasts — until the metric
+recovers, which emits the paired ``state="resolve"`` event (``rule`` /
+``severity`` / ``value`` / ``threshold`` / ``window`` / ``state``). A
+flapping metric produces a fire/resolve pair per flap, never a re-fire
+per cycle. Alerts are *never* load-bearing — the engine only ever writes
+telemetry, and the sink it writes through already degrades to a no-op on
+ENOSPC.
 
 Rule DSL (``Config.alert_rules`` / ``--alert-rules``, comma-separated)::
 
@@ -157,11 +161,13 @@ def parse_rules(spec: Optional[str]) -> list[AlertRule]:
     return rules
 
 
-def fire(rule: AlertRule, value: float, window: int) -> None:
-    """One structured ``alert`` event for a violated rule. ``window`` is
-    the aggregator's emission sequence number — the report marks a rule
-    ACTIVE only while its last alert's window matches the latest summary,
-    so a long-recovered alert never reads as live."""
+def fire(rule: AlertRule, value: float, window: int,
+         state: str = "fire") -> None:
+    """One structured ``alert`` event — ``state="fire"`` when the rule
+    crosses into violation, ``state="resolve"`` when it recovers (the
+    hysteresis pair; the aggregator tracks which transition this is).
+    ``window`` is the aggregator's emission sequence number. The report
+    marks a rule ACTIVE while its last event is an unresolved fire."""
     _events.emit("alert", rule=rule.metric, severity=rule.severity,
                  value=round(float(value), 6), threshold=rule.threshold,
-                 window=window)
+                 window=window, state=state)

@@ -68,6 +68,15 @@ DIRECTIONS = {
     "e2e_hbm_samples_per_sec": "min",
     "spread_pct": "max",
     "serving_spread_pct": "max",
+    # int8 serving throughput (runtime registry's serve_packed_int8) and
+    # time-to-first-step through the persistent executable cache: cold =
+    # fresh XLA compile, warm = guarded cache load. Both TTFS keys
+    # regress UPWARD — a warm start creeping back toward cold means the
+    # cache stopped serving (rejects, fingerprint churn).
+    "serving_int8_inferences_per_sec_per_chip": "min",
+    "serving_int8_spread_pct": "max",
+    "ttfs_cold_s": "max",
+    "ttfs_warm_s": "max",
 }
 
 
@@ -119,6 +128,10 @@ BENCH_GATE_KEYS = (
     "e2e_hbm_samples_per_sec",
     "spread_pct",
     "serving_spread_pct",
+    "serving_int8_inferences_per_sec_per_chip",
+    "serving_int8_spread_pct",
+    "ttfs_cold_s",
+    "ttfs_warm_s",
     "window_data_wait_p50_ms",
     "window_data_wait_p99_ms",
     "window_queue_depth_p50",

@@ -310,10 +310,13 @@ def _report_rules(manifest: Optional[dict]) -> list:
 def _slo_section(events: list[dict], primary: list[dict]) -> dict:
     """Fold ``window_summary`` + ``alert`` events into the SLO view:
     latest window percentiles per metric (primary host — the canonical
-    loop) and per-rule alert firing counts across every host (an alert on
-    host 3 must not be invisible in the headline). A rule is ACTIVE only
-    while its last alert's ``window`` seq matches its host's latest
-    summary — a long-recovered alert never reads as live."""
+    loop) and per-rule alert counts across every host (an alert on host 3
+    must not be invisible in the headline). Alerts are hysteresis pairs:
+    a rule is ACTIVE while its last transition on ANY host is an
+    unresolved ``state="fire"``; ``count`` counts fires, ``resolves``
+    their recoveries. Legacy streams (pre-hysteresis, no ``state``) fall
+    back to the old heuristic — the last alert's ``window`` seq matching
+    that host's latest summary."""
     out: dict = {}
     windows: dict = {}
     for e in primary:
@@ -337,17 +340,26 @@ def _slo_section(events: list[dict], primary: list[dict]) -> dict:
     for e in events:
         if e["ev"] != "alert":
             continue
-        r = alerts.setdefault(e["rule"], {"count": 0, "active": False})
-        r["count"] += 1
+        r = alerts.setdefault(
+            e["rule"], {"count": 0, "resolves": 0, "active": False}
+        )
+        if e.get("state") == "resolve":
+            r["resolves"] += 1
+        else:
+            r["count"] += 1
         r["last_value"] = e.get("value")
         r["threshold"] = e.get("threshold")
         r["severity"] = e.get("severity")
         last_per_host[(e["rule"], int(e.get("process_index") or 0))] = e
-    # Active = ANY host whose latest alert for the rule matches that
-    # host's latest summary cycle — a rule still live on host 0 must not
-    # be masked by a later-timestamped recovered firing on host 3.
+    # Active = ANY host whose latest transition is an unresolved fire — a
+    # rule still live on host 0 must not be masked by a later-timestamped
+    # recovered firing on host 3.
     for (rule, h), e in last_per_host.items():
-        if e.get("window") is not None and e["window"] == latest_seq.get(h):
+        if "state" in e:
+            if e["state"] == "fire":
+                alerts[rule]["active"] = True
+        elif e.get("window") is not None \
+                and e["window"] == latest_seq.get(h):
             alerts[rule]["active"] = True
     if alerts:
         out["alerts"] = alerts
@@ -487,6 +499,31 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
                  **{k: v for k, v in e.items()
                     if k not in ("t", "ev", "pid")}}
                 for e in rec
+            ],
+        }
+
+    # --- runtime registry: compiles vs executable-cache verdicts ------------
+    # Every host's stream counts (respawned children each pay their own
+    # compiles — that is exactly the cost the cache exists to collapse).
+    rts = [e for e in events
+           if e["ev"] in ("program_compile", "cache_hit", "cache_miss",
+                          "cache_reject")]
+    if rts:
+        compiles = [e for e in rts if e["ev"] == "program_compile"]
+        rep["runtime"] = {
+            "compiles": len(compiles),
+            "compile_s": round(
+                sum(e.get("dur_s", 0.0) for e in compiles), 3
+            ),
+            "cache_hits": sum(e["ev"] == "cache_hit" for e in rts),
+            "cache_misses": sum(e["ev"] == "cache_miss" for e in rts),
+            "cache_rejects": sum(e["ev"] == "cache_reject" for e in rts),
+            "programs": sorted({
+                e.get("program", "?") for e in rts
+            }),
+            "rejects": [
+                {"program": e.get("program"), "reason": e.get("reason")}
+                for e in rts if e["ev"] == "cache_reject"
             ],
         }
 
@@ -641,8 +678,23 @@ def format_report(rep: dict) -> str:
             a = sa[rule]
             lines.append(
                 f"  {'ACTIVE' if a.get('active') else 'fired '} "
-                f"{rule:<22} ×{a['count']}  last {a.get('last_value')} "
+                f"{rule:<22} ×{a['count']}"
+                + (f" (resolved ×{a['resolves']})" if a.get("resolves")
+                   else "")
+                + f"  last {a.get('last_value')} "
                 f"vs {a.get('threshold')} ({a.get('severity')})"
+            )
+    rt = rep.get("runtime")
+    if rt:
+        lines.append(
+            f"runtime: {rt['compiles']} compile(s) "
+            f"({rt['compile_s']}s XLA), cache {rt['cache_hits']} hit(s) / "
+            f"{rt['cache_misses']} miss(es) / {rt['cache_rejects']} "
+            f"reject(s)"
+        )
+        for r in rt["rejects"]:
+            lines.append(
+                f"  REJECT {r.get('program')}: {r.get('reason')}"
             )
     q = rep.get("prefetch_queue_depth")
     if q:
@@ -872,8 +924,15 @@ KNOWN_EVENT_KINDS = frozenset({
     # fell back past a corrupt latest checkpoint.
     "preempt", "checkpoint_fallback",
     # Live-SLO events (obs.windows / obs.alerts): a rolling-window
-    # percentile snapshot, and an alert rule that snapshot violated.
+    # percentile snapshot, and an alert rule crossing into violation
+    # (state="fire") or recovering (state="resolve") — hysteresis pairs,
+    # never per-cycle re-fires.
     "window_summary", "alert",
+    # Runtime-registry events (featurenet_tpu.runtime): an XLA compile of
+    # a named program, and the persistent executable cache's verdicts —
+    # hit (deserialized, compile skipped), miss (no entry), reject (entry
+    # present but corrupt/stale/probe-refused; degraded to fresh compile).
+    "program_compile", "cache_hit", "cache_miss", "cache_reject",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -888,7 +947,19 @@ REQUIRED_EVENT_FIELDS = {
     "preempt": ("step",),
     "checkpoint_fallback": ("from_step", "to_step"),
     "window_summary": ("metric", "n", "p50", "p95", "p99"),
-    "alert": ("rule", "severity", "value", "threshold", "window"),
+    "alert": ("rule", "severity", "value", "threshold", "window", "state"),
+    "program_compile": ("program", "dur_s"),
+    "cache_hit": ("program",),
+    "cache_miss": ("program",),
+    "cache_reject": ("program", "reason"),
+}
+
+# Required at EMIT sites (the analysis linter holds new code to the full
+# tuples above) but tolerated as absent by ``validate_events``: archived
+# run dirs predate the field and must keep validating, mirroring the
+# legacy fallbacks the report sections already implement.
+LEGACY_OPTIONAL_FIELDS = {
+    "alert": ("state",),  # pre-hysteresis streams re-fired with no state
 }
 
 # Wall-clock start stamps vs perf_counter durations: a parent records its
@@ -921,9 +992,10 @@ def validate_events(events: list[dict], bad_lines: int = 0) -> list[dict]:
                 "event": e,
             })
             continue
+        legacy_ok = LEGACY_OPTIONAL_FIELDS.get(ev, ())
         missing = [
             f for f in REQUIRED_EVENT_FIELDS.get(ev, ())
-            if f not in e
+            if f not in e and f not in legacy_ok
         ]
         if missing:
             findings.append({

@@ -121,6 +121,10 @@ class WindowAggregator:
         self._lock = threading.Lock()
         self._seq = 0
         self._last_emit = time.perf_counter()
+        # Hysteresis state per rule metric: True between a fired alert and
+        # its paired resolve — a violation lasting N cycles is ONE alert,
+        # not N (carried-over SLO follow-on).
+        self._alert_active: dict[str, bool] = {}
 
     def observe(self, metric: str, value: float) -> None:
         win = self._win.get(metric)
@@ -155,8 +159,17 @@ class WindowAggregator:
             if rule.scope != "process":
                 continue  # cross-host rules are the report's to judge
             value = self.rule_value(rule.metric, now)
-            if value is not None and rule.violated(value):
-                _alerts.fire(rule, value, self._seq)
+            if value is None:
+                continue
+            active = self._alert_active.get(rule.metric, False)
+            if rule.violated(value) and not active:
+                # Crossing INTO violation: one fire, then silence until
+                # the paired resolve below.
+                _alerts.fire(rule, value, self._seq, state="fire")
+                self._alert_active[rule.metric] = True
+            elif not rule.violated(value) and active:
+                _alerts.fire(rule, value, self._seq, state="resolve")
+                self._alert_active[rule.metric] = False
 
     def rule_value(self, metric: str, now: float) -> Optional[float]:
         """Resolve a rule metric against the current windows: a derived

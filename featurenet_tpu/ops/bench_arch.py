@@ -15,6 +15,12 @@ levers follow, both expressible as arch configs without touching the model:
 
 Run on the real chip: ``python -m featurenet_tpu.ops.bench_arch``
 (one JSON line per variant × batch; ~1 min total).
+
+Measurement core: ``featurenet_tpu.benchmark.measure_train_step``, which
+builds the swept step as the runtime registry's ``train_step`` program
+(``featurenet_tpu.runtime``) — the sweep times exactly the executable the
+Trainer dispatches, sharding/donation decisions included, and an
+``--exec-cache-dir``-style persistent cache can serve repeat sweeps.
 """
 
 from __future__ import annotations

@@ -1,0 +1,39 @@
+"""Compiled-program runtime: registry, persistent AOT cache, int8 serving.
+
+``runtime.registry`` is the one front door every entry point builds its
+XLA programs through (enumerable, rebuildable, warmable);
+``runtime.cache`` keeps the compiled executables on disk behind a
+probe-in-subprocess guard; ``runtime.quantize`` is the int8 post-training
+weight quantizer the ``*_int8`` serving programs run on. See each
+module's docstring — and README "Runtime registry" — for the contract.
+"""
+
+from featurenet_tpu.runtime.cache import (
+    ExecutableCache,
+    cache_from_config,
+    env_fingerprint,
+    program_fingerprint,
+)
+from featurenet_tpu.runtime.registry import (
+    PROGRAMS,
+    CompiledProgram,
+    ProgramSpec,
+    Runtime,
+    build_model,
+    hbm_rows_estimate,
+    list_programs,
+)
+
+__all__ = [
+    "PROGRAMS",
+    "CompiledProgram",
+    "ExecutableCache",
+    "ProgramSpec",
+    "Runtime",
+    "build_model",
+    "cache_from_config",
+    "env_fingerprint",
+    "hbm_rows_estimate",
+    "list_programs",
+    "program_fingerprint",
+]

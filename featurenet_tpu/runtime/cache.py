@@ -1,0 +1,349 @@
+"""Persistent AOT executable cache: compile once, deserialize on respawn.
+
+FeatureNet's workload is shape-monomorphic by design (fixed grids, fixed
+batch, 24 classes), so every supervisor respawn, preemption resume, and
+serving cold start re-pays an XLA compile for a program that is bit-for-bit
+the one the previous process already built. This module keeps the compiled
+executables on disk — serialized via ``jax.experimental.serialize_executable``
+(the machinery under ``jax.export``/``compiled.serialize``) — keyed by a
+fingerprint of everything that could invalidate them: jax/jaxlib version,
+backend platform and device topology, program name, the config's identity
+fields (arch hash), and the program's input shapes/dtypes/precision.
+
+**Load-bearing hazard (PR 1):** executing an executable DESERIALIZED from a
+persistent cache can FATALLY ABORT this sandbox — the XLA AOT loader's
+machine-feature validation escalates from a logged SIGILL-class complaint
+to a process abort, which no in-process ``try`` can catch. Cache loads are
+therefore guarded:
+
+- The cache as a whole is opt-in (``Config.exec_cache_dir`` /
+  ``--exec-cache-dir`` / ``FEATURENET_EXEC_CACHE_DIR``); no directory, no
+  deserialization anywhere.
+- Before an entry is deserialized in-process, a throwaway SUBPROCESS
+  deserializes and loads it first (``python -m featurenet_tpu.runtime.cache
+  --probe <entry>``). The AOT loader's validation runs there; if the child
+  dies — by exit code or by signal — the parent records the entry as
+  rejected and falls back to a fresh compile. A passed probe is remembered
+  in a ``.ok`` sidecar (keyed by env fingerprint + entry digest) so later
+  cold starts skip the spawn.
+- Every in-process read/deserialize is wrapped: a corrupt file, a stale
+  fingerprint, a version-skewed payload — each degrades to a fresh compile
+  with a ``cache_reject`` event, never a crash.
+
+``FEATURENET_EXEC_CACHE_PROBE`` overrides the guard policy: ``subprocess``
+(default), ``trust`` (skip the probe — for environments proven good), or
+``reject`` (never load; still *store*, so a later environment can warm up).
+
+File format (one file per program × shape signature, atomic rename on
+write): ``MAGIC | u64 header length | header JSON | payload``. The header
+carries the full fingerprint; a mismatch (e.g. a jax upgrade) is a
+``stale_fingerprint`` reject and the entry is recompiled and overwritten.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional
+
+MAGIC = b"FNXC1\n"
+PROBE_ENV = "FEATURENET_EXEC_CACHE_PROBE"
+DIR_ENV = "FEATURENET_EXEC_CACHE_DIR"
+PROBE_MODES = ("subprocess", "trust", "reject")
+PROBE_TIMEOUT_S = 300.0
+
+
+def env_fingerprint() -> str:
+    """Everything environmental that invalidates a serialized executable:
+    jax/jaxlib versions, backend platform, device kind and count."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    parts = (
+        jax.__version__,
+        jaxlib.__version__,
+        dev.platform,
+        getattr(dev, "device_kind", ""),
+        str(len(jax.devices())),
+    )
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def program_fingerprint(name: str, identity: dict, meta: dict) -> str:
+    """Full cache key: environment + config identity (arch hash) + the
+    program's own meta (input shapes/dtypes, precision, donation)."""
+    blob = json.dumps(
+        {"env": env_fingerprint(), "program": name,
+         "identity": identity, "meta": meta},
+        sort_keys=True, default=str,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def meta_digest(meta: dict, identity: Optional[dict] = None) -> str:
+    """Short digest used in the entry FILENAME: the program's shape
+    signature plus the config identity, so two batch sizes of one program
+    — and two CONFIGS sharing one cache directory (e.g. different
+    conv_backend presets warmed into a fleet-wide dir) — coexist instead
+    of stale-reject-evicting each other. Deliberately excludes the
+    environment, so a jax upgrade lands on the SAME file and is detected
+    as a ``stale_fingerprint`` reject rather than silently orphaning the
+    old entry."""
+    blob = json.dumps({"meta": meta, "identity": identity},
+                      sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _read_entry(path: str) -> tuple[dict, bytes]:
+    """Parse an entry file; raises ValueError on any corruption."""
+    with open(path, "rb") as fh:
+        magic = fh.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"bad magic {magic!r}")
+        raw_len = fh.read(8)
+        if len(raw_len) != 8:
+            raise ValueError("truncated header length")
+        n = int.from_bytes(raw_len, "little")
+        if not (0 < n < 10_000_000):
+            raise ValueError(f"implausible header length {n}")
+        raw = fh.read(n)
+        if len(raw) != n:
+            raise ValueError("truncated header")
+        header = json.loads(raw.decode("utf-8"))
+        payload = fh.read()
+    if not isinstance(header, dict) or not payload:
+        raise ValueError("empty header or payload")
+    return header, payload
+
+
+def _write_entry(path: str, header: dict, payload: bytes) -> None:
+    raw = json.dumps(header, sort_keys=True, default=str).encode("utf-8")
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(MAGIC)
+            fh.write(len(raw).to_bytes(8, "little"))
+            fh.write(raw)
+            fh.write(payload)
+        os.replace(tmp, path)  # atomic: a killed run never leaves half a file
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def probe_load(path: str) -> None:
+    """Deserialize + LOAD the entry's executable (no execution) — the AOT
+    loader's machine-feature validation runs here. Meant to run in a
+    throwaway subprocess: this is exactly the step that can fatally abort
+    a poisoned environment."""
+    import io
+    import pickle
+
+    import jax
+    from jax._src.lib import xla_client as xc  # noqa: F401 (backend init)
+
+    _, payload = _read_entry(path)
+    backend = jax.devices()[0].client
+
+    class _Unpickler(pickle.Unpickler):
+        def __init__(self, file):
+            super().__init__(file)
+            self.devices_by_id = {d.id: d for d in backend.devices()}
+
+        def persistent_load(self, pid):
+            if pid[0] == "exec":
+                return backend.deserialize_executable(pid[1])
+            if pid[0] == "device":
+                return self.devices_by_id[pid[1]]
+            if pid[0] == "client":
+                return backend
+            raise pickle.UnpicklingError(str(pid[0]))
+
+    unloaded, _, _ = _Unpickler(io.BytesIO(payload)).load()
+    if hasattr(unloaded, "load"):
+        unloaded.load()
+
+
+class ExecutableCache:
+    """On-disk executable cache with guarded loads (module docstring)."""
+
+    def __init__(self, directory: str, probe: Optional[str] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.probe = probe or os.environ.get(PROBE_ENV) or "subprocess"
+        if self.probe not in PROBE_MODES:
+            raise ValueError(
+                f"unknown exec-cache probe mode {self.probe!r}; one of "
+                f"{', '.join(PROBE_MODES)}"
+            )
+        # In-process probe memo: entry path -> verdict for this process.
+        self._probed: dict[str, bool] = {}
+
+    # -- paths ---------------------------------------------------------------
+    def entry_path(self, name: str, digest: str) -> str:
+        return os.path.join(self.directory, f"{name}-{digest}.jexec")
+
+    def entries(self) -> list[str]:
+        try:
+            return sorted(
+                n for n in os.listdir(self.directory) if n.endswith(".jexec")
+            )
+        except OSError:
+            return []
+
+    # -- guarded load --------------------------------------------------------
+    def load(self, name: str, fingerprint: str, digest: str, lowered):
+        """``(compiled, "hit")`` on a verified cache hit; ``(None, reason)``
+        otherwise — ``reason`` is ``"miss"`` for a simple absence and a
+        reject cause (``stale_fingerprint`` / ``corrupt_entry`` /
+        ``probe_failed`` / ``probe_rejected`` / ``deserialize_error``) for
+        everything that falls back to a fresh compile with a
+        ``cache_reject`` event."""
+        path = self.entry_path(name, digest)
+        if not os.path.exists(path):
+            return None, "miss"
+        try:
+            header, payload = _read_entry(path)
+        except (ValueError, OSError) as e:
+            return None, f"corrupt_entry:{type(e).__name__}"
+        if header.get("fingerprint") != fingerprint:
+            return None, "stale_fingerprint"
+        if self.probe == "reject":
+            return None, "probe_rejected"
+        if self.probe == "subprocess" and not self._probe_entry(path):
+            return None, "probe_failed"
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            compiled = deserialize_and_load(
+                payload, lowered.in_tree, lowered.out_tree
+            )
+        except Exception as e:  # version-skewed payload, tree mismatch, …
+            return None, f"deserialize_error:{type(e).__name__}"
+        return compiled, "hit"
+
+    def store(self, name: str, fingerprint: str, digest: str, compiled,
+              meta: dict) -> bool:
+        """Serialize + write an entry; False when this executable kind does
+        not support serialization (never an error — the cache is an
+        optimization, the fresh compile already happened)."""
+        try:
+            from jax.experimental.serialize_executable import serialize
+
+            payload, _, _ = serialize(compiled)
+        except (ValueError, TypeError):
+            return False
+        header = {
+            "program": name,
+            "fingerprint": fingerprint,
+            "meta": meta,
+            "created": time.time(),
+        }
+        try:
+            _write_entry(self.entry_path(name, digest), header, payload)
+        except OSError:
+            return False  # full/read-only disk: cache quietly absent
+        self._probed.pop(self.entry_path(name, digest), None)
+        self._drop_marker(self.entry_path(name, digest))
+        return True
+
+    # -- the subprocess probe ------------------------------------------------
+    def _marker_path(self, path: str) -> str:
+        return path + ".ok"
+
+    def _drop_marker(self, path: str) -> None:
+        try:
+            os.unlink(self._marker_path(path))
+        except OSError:
+            pass
+
+    def _probe_entry(self, path: str) -> bool:
+        if path in self._probed:
+            return self._probed[path]
+        ok = self._check_marker(path)
+        if ok is None:
+            ok = self._run_probe(path)
+            if ok:
+                try:
+                    with open(self._marker_path(path), "w") as fh:
+                        json.dump({"env": env_fingerprint(),
+                                   "entry_sha": _file_digest(path)}, fh)
+                except OSError:
+                    pass
+        self._probed[path] = ok
+        return ok
+
+    def _check_marker(self, path: str) -> Optional[bool]:
+        """True when a previous probe of this exact entry (same bytes, same
+        environment) passed; None when there is no trustworthy verdict."""
+        try:
+            with open(self._marker_path(path)) as fh:
+                marker = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if (marker.get("env") == env_fingerprint()
+                and marker.get("entry_sha") == _file_digest(path)):
+            return True
+        return None
+
+    def _run_probe(self, path: str) -> bool:
+        """Deserialize+load the entry in a throwaway child; a child death —
+        exit code OR signal — is the abort the guard exists to absorb."""
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "featurenet_tpu.runtime.cache",
+                 "--probe", path],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+        except (subprocess.TimeoutExpired, OSError):
+            return False
+        return r.returncode == 0 and "probe-ok" in (r.stdout or "")
+
+
+def cache_from_config(cfg) -> Optional[ExecutableCache]:
+    """The configured cache, or None: ``Config.exec_cache_dir`` wins, then
+    the ``FEATURENET_EXEC_CACHE_DIR`` environment (so a supervisor fleet
+    can be warmed without touching every launch command)."""
+    directory = getattr(cfg, "exec_cache_dir", None) or os.environ.get(DIR_ENV)
+    return ExecutableCache(directory) if directory else None
+
+
+def main(argv=None) -> int:
+    """``python -m featurenet_tpu.runtime.cache --probe <entry>`` — the
+    subprocess side of the guarded load. Prints ``probe-ok`` and exits 0
+    only when the entry deserializes AND the AOT loader accepts it."""
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) != 2 or args[0] != "--probe":
+        print("usage: python -m featurenet_tpu.runtime.cache --probe <entry>",
+              file=sys.stderr)
+        return 2
+    try:
+        probe_load(args[1])
+    except Exception as e:
+        print(f"probe-failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print("probe-ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,719 @@
+"""The compiled-program runtime registry: every XLA program, one front door.
+
+``train/loop.py``, ``infer.py``, and ``benchmark.py`` (the core under
+``ops/bench_arch.py`` and root ``bench.py``) used to hand-build their own
+jitted callables — their own sharding/donation decisions, their own compile
+timing, no way to enumerate what a config compiles or to rebuild it
+elsewhere. This module is the refactor unlock (ROADMAP item 5): a
+``Program`` is a named record — pure function, abstract input
+shapes/dtypes, shardings, donation, precision — and the ``Runtime`` builds
+it ``build → lower → compile`` with:
+
+- **Enumeration**: ``Runtime.programs()`` / ``list_programs(cfg)`` say
+  exactly which programs a config runs (``cli programs`` renders it), and
+  ``Runtime.warmup()`` compiles them ahead of traffic — serving cold
+  starts pay compilation before the first request, not during it.
+- **A persistent AOT executable cache** (``runtime.cache``): with
+  ``Config.exec_cache_dir`` set, compiled executables are serialized to
+  disk keyed by a full fingerprint (jax/jaxlib, backend, program, arch
+  hash, shapes/dtypes, precision) and respawns/resumes/cold starts
+  deserialize instead of recompiling. Loads are guarded (see the cache
+  module's hazard note): any failure degrades to a fresh compile with a
+  ``cache_reject`` event — never a crash.
+- **Observability**: ``program_compile`` / ``cache_hit`` / ``cache_miss``
+  / ``cache_reject`` events make time-to-first-step attributable from the
+  run log alone (bench pins cold vs warm TTFS in its gate summary).
+- **An int8 serving path** (``runtime.quantize``): ``serve_int8`` /
+  ``serve_packed_int8`` run the same forward over per-channel-quantized
+  int8 weights, dequantized on device — the serving throughput rung of
+  ROADMAP item 2, accuracy-gated in tests against the paper's 96.7%
+  target.
+
+Program catalog (availability depends on the config):
+
+==================  =========================================================
+``init``            sharded state init (params/opt-state materialized
+                    directly on their devices)
+``train_step``      one fused fwd+bwd+optimizer+BN step (donated state)
+``multi_train_step``  ``k`` steps fused into one executable
+                    (``steps_per_dispatch > 1``)
+``hbm_train_step``  steps that sample batches from the HBM-resident split
+                    (``hbm_cache``; needs the resident arrays' shapes)
+``eval_step``       exact-sum eval forward
+``serve``           the Predictor forward: fp32 weights → probs (classify)
+                    or int8 per-voxel labels (segment); single-device
+``serve_int8``      same forward over int8-quantized weights
+``serve_packed``    the bench serving program: packed voxels → labels,
+                    sharded over the mesh (classify only)
+``serve_packed_int8``  its int8-weight variant
+==================  =========================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from featurenet_tpu import obs
+from featurenet_tpu.config import IDENTITY_FIELDS, Config, config_to_dict
+from featurenet_tpu.runtime.cache import (
+    ExecutableCache,
+    cache_from_config,
+    meta_digest,
+    program_fingerprint,
+)
+
+PRECISIONS = ("fp32", "int8")
+
+_FROM_CONFIG = object()  # sentinel: derive the cache from cfg.exec_cache_dir
+
+
+def build_model(cfg: Config):
+    """The module tree a config trains/serves (single source of truth —
+    the Trainer, Predictor, and every registry program build through
+    here)."""
+    from featurenet_tpu.models.featurenet import FeatureNet
+    from featurenet_tpu.models.segmenter import FeatureNetSegmenter
+
+    if cfg.task == "segment":
+        return FeatureNetSegmenter(
+            features=tuple(cfg.seg_features),
+            input_context=cfg.seg_input_context,
+            decoder_blocks=cfg.seg_decoder_blocks,
+            bottleneck_blocks=cfg.seg_bottleneck_blocks,
+        )
+    return FeatureNet(arch=cfg.arch)
+
+
+def hbm_rows_estimate(cfg: Config) -> int:
+    """Train-split row count ``hbm_cache`` mode will hold resident — read
+    from the cache's index metadata (cheap; needed before the dataset is
+    built, e.g. for the dispatch-k clamp)."""
+    if not (cfg.hbm_cache and cfg.data_cache):
+        return 0
+    import json
+    import os
+
+    try:
+        with open(os.path.join(cfg.data_cache, "index.json")) as fh:
+            index = json.load(fh)
+        if index.get("kind") == "segment":
+            total = sum(s["count"] for s in index["shards"])
+        else:
+            total = sum(index["counts"].values())
+        return int(total * (1.0 - cfg.test_fraction))
+    except (OSError, KeyError, ValueError):
+        return 0  # the Trainer's own cache open will raise the real error
+
+
+def _key_aval():
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _aval_of(x):
+    """ShapeDtypeStruct view of an array or an existing aval."""
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+def _meta_avals(tree) -> Any:
+    """JSON-able shapes/dtypes summary of an abstract-args pytree — the
+    shape signature half of the cache fingerprint."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return [[list(map(int, l.shape)), str(l.dtype)] for l in leaves]
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One compiled program, described before compilation: the pure
+    function, its abstract inputs, its sharding/donation decisions, and
+    the precision of the weights it runs."""
+
+    name: str
+    fn: Callable
+    abstract_args: tuple
+    precision: str = "fp32"
+    in_shardings: Any = None
+    out_shardings: Any = None
+    donate_argnums: tuple = ()
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    def jit_kwargs(self) -> dict:
+        kw: dict = {}
+        if self.in_shardings is not None:
+            kw["in_shardings"] = self.in_shardings
+        if self.out_shardings is not None:
+            kw["out_shardings"] = self.out_shardings
+        if self.donate_argnums:
+            kw["donate_argnums"] = self.donate_argnums
+        return kw
+
+
+@dataclasses.dataclass
+class CompiledProgram:
+    """A built program: call it like the function it wraps."""
+
+    spec: ProgramSpec
+    compiled: Any  # jax.stages.Compiled
+    source: str    # "fresh" (XLA compiled it now) or "cache" (deserialized)
+    build_s: float
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    @property
+    def precision(self) -> str:
+        return self.spec.precision
+
+    def __call__(self, *args):
+        return self.compiled(*args)
+
+
+# --- program builders --------------------------------------------------------
+# name -> (builder(rt, **kw) -> ProgramSpec, one-line doc,
+#          applicable(cfg) -> bool)
+
+def _always(cfg: Config) -> bool:
+    return True
+
+
+def _spec_init(rt: "Runtime") -> ProgramSpec:
+    return ProgramSpec(
+        name="init",
+        fn=rt._init_fn,
+        abstract_args=(_key_aval(),),
+        out_shardings=rt.state_sh,
+        meta={"kind": "init", "avals": _meta_avals(rt.abstract_state)},
+    )
+
+
+def _spec_train_step(rt: "Runtime") -> ProgramSpec:
+    from featurenet_tpu.train.steps import make_train_step
+
+    args = (rt.abstract_state, rt.batch_avals(), _key_aval())
+    return ProgramSpec(
+        name="train_step",
+        fn=make_train_step(rt.model, rt.cfg.task, **rt.step_kwargs()),
+        abstract_args=args,
+        in_shardings=(rt.state_sh, rt.batch_sh, rt.rep),
+        out_shardings=(rt.state_sh, rt.rep),
+        donate_argnums=(0,),
+        meta={"kind": "train_step", "avals": _meta_avals(args)},
+    )
+
+
+def _spec_multi_train_step(rt: "Runtime",
+                           num_steps: Optional[int] = None) -> ProgramSpec:
+    from featurenet_tpu.train.steps import make_multi_train_step
+
+    if num_steps is None:
+        # Default (warmup path) to the k the Trainer actually dispatches:
+        # the requested steps_per_dispatch clamped against the analytic
+        # HBM byte model. An unclamped default would risk the compile-time
+        # OOM the clamp exists to prevent AND warm a cache entry whose
+        # digest (meta num_steps) no real run ever looks up.
+        from featurenet_tpu.train.state import param_count
+
+        num_steps = rt.dispatch_k(param_count(rt.abstract_state.params))
+    k = max(2, num_steps)
+    args = (rt.abstract_state, (rt.batch_avals(),) * k, _key_aval())
+    return ProgramSpec(
+        name="multi_train_step",
+        fn=make_multi_train_step(
+            rt.model, rt.cfg.task, num_steps=k, **rt.step_kwargs()
+        ),
+        abstract_args=args,
+        in_shardings=(rt.state_sh, (rt.batch_sh,) * k, rt.rep),
+        out_shardings=(rt.state_sh, rt.rep),
+        donate_argnums=(0,),
+        meta={"kind": "multi_train_step", "num_steps": k,
+              "avals": _meta_avals(args)},
+    )
+
+
+def _spec_hbm_train_step(rt: "Runtime", num_steps: int = 1,
+                         data=None, targets=None) -> ProgramSpec:
+    """Needs the RESIDENT arrays (or their avals): the executable bakes the
+    uploaded split's row count into its sampling, so the shapes must be
+    the materialized ones, not an index estimate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from featurenet_tpu.train.steps import make_hbm_multi_train_step
+
+    if data is None or targets is None:
+        raise ValueError(
+            "hbm_train_step needs the resident arrays (data=, targets=) — "
+            "their shapes come from materialize_split, not the cache index"
+        )
+    cfg = rt.cfg
+    d_sh = NamedSharding(rt.mesh, P("data"))
+    args = (rt.abstract_state, _aval_of(data), _aval_of(targets), _key_aval())
+    return ProgramSpec(
+        name="hbm_train_step",
+        fn=make_hbm_multi_train_step(
+            rt.model, rt.mesh, cfg.global_batch, cfg.task,
+            cfg.label_smoothing,
+            augment_groups=(
+                cfg.augment_groups if cfg.device_augment else 0
+            ),
+            num_steps=num_steps,
+            seg_loss=cfg.seg_loss,
+            augment_noise=cfg.augment_noise,
+            augment_affine=cfg.augment_affine,
+            affine_opts=rt.step_kwargs()["affine_opts"],
+        ),
+        abstract_args=args,
+        in_shardings=(rt.state_sh, d_sh, d_sh, rt.rep),
+        out_shardings=(rt.state_sh, rt.rep),
+        donate_argnums=(0,),
+        meta={"kind": "hbm_train_step", "num_steps": num_steps,
+              "avals": _meta_avals(args)},
+    )
+
+
+def _spec_eval_step(rt: "Runtime") -> ProgramSpec:
+    from featurenet_tpu.train.steps import make_eval_step
+
+    args = (rt.abstract_state.params, rt.abstract_state.batch_stats,
+            rt.batch_avals())
+    return ProgramSpec(
+        name="eval_step",
+        fn=make_eval_step(rt.model, rt.cfg.task, packed=True),
+        abstract_args=args,
+        in_shardings=(rt.state_sh.params, rt.state_sh.batch_stats,
+                      rt.batch_sh),
+        out_shardings=rt.rep,
+        meta={"kind": "eval_step", "avals": _meta_avals(args)},
+    )
+
+
+def _serve_fn(rt: "Runtime"):
+    """The Predictor forward: probs for classify, on-device argmax to int8
+    labels for segment (so labels, not a 25-channel fp32 volume, cross
+    back to the host)."""
+    import jax.numpy as jnp
+
+    model, task = rt.model, rt.cfg.task
+
+    def forward(params, batch_stats, voxels):
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, voxels,
+            train=False,
+        )
+        if task == "segment":
+            return jnp.argmax(logits, axis=-1).astype(jnp.int8)
+        return jax.nn.softmax(logits, axis=-1)
+
+    return forward
+
+
+def _spec_serve(rt: "Runtime", batch: int = 32) -> ProgramSpec:
+    R = rt.cfg.resolution
+    args = (rt.abstract_state.params, rt.abstract_state.batch_stats,
+            _sds((batch, R, R, R, 1), np.float32))
+    return ProgramSpec(
+        name="serve",
+        fn=_serve_fn(rt),
+        abstract_args=args,
+        meta={"kind": "serve", "batch": batch, "avals": _meta_avals(args)},
+    )
+
+
+def _spec_serve_int8(rt: "Runtime", batch: int = 32) -> ProgramSpec:
+    from featurenet_tpu.runtime.quantize import dequantize_tree, quantize_tree
+
+    R = rt.cfg.resolution
+    fwd = _serve_fn(rt)
+
+    def forward(q_params, scales, batch_stats, voxels):
+        return fwd(dequantize_tree(q_params, scales), batch_stats, voxels)
+
+    q_aval, s_aval = jax.eval_shape(quantize_tree, rt.abstract_state.params)
+    args = (q_aval, s_aval, rt.abstract_state.batch_stats,
+            _sds((batch, R, R, R, 1), np.float32))
+    return ProgramSpec(
+        name="serve_int8",
+        fn=forward,
+        abstract_args=args,
+        precision="int8",
+        meta={"kind": "serve_int8", "batch": batch,
+              "avals": _meta_avals(args)},
+    )
+
+
+def _packed_sharding(rt: "Runtime"):
+    from featurenet_tpu.parallel.mesh import batch_shardings
+
+    return batch_shardings(rt.mesh, keys=("voxels",))["voxels"]
+
+
+def _spec_serve_packed(rt: "Runtime",
+                       global_batch: Optional[int] = None) -> ProgramSpec:
+    import jax.numpy as jnp
+
+    from featurenet_tpu.train.steps import unpack_voxels
+
+    model = rt.model
+    B = global_batch or rt.cfg.global_batch
+    R = rt.cfg.resolution
+
+    def serve(variables, packed):
+        x = unpack_voxels(packed)  # [B,R,R,R,1] f32; model casts to bf16
+        logits = model.apply(variables, x, train=False)
+        return jnp.argmax(logits, axis=-1)
+
+    args = (rt.abstract_variables(), _sds((B, R, R, R // 8), np.uint8))
+    return ProgramSpec(
+        name="serve_packed",
+        fn=serve,
+        abstract_args=args,
+        in_shardings=(rt.rep, _packed_sharding(rt)),
+        meta={"kind": "serve_packed", "avals": _meta_avals(args)},
+    )
+
+
+def _spec_serve_packed_int8(rt: "Runtime",
+                            global_batch: Optional[int] = None
+                            ) -> ProgramSpec:
+    import jax.numpy as jnp
+
+    from featurenet_tpu.runtime.quantize import dequantize_tree, quantize_tree
+    from featurenet_tpu.train.steps import unpack_voxels
+
+    model = rt.model
+    B = global_batch or rt.cfg.global_batch
+    R = rt.cfg.resolution
+    var_aval = rt.abstract_variables()
+    q_aval, s_aval = jax.eval_shape(quantize_tree, var_aval["params"])
+
+    def serve(q_params, scales, batch_stats, packed):
+        x = unpack_voxels(packed)
+        logits = model.apply(
+            {"params": dequantize_tree(q_params, scales),
+             "batch_stats": batch_stats},
+            x, train=False,
+        )
+        return jnp.argmax(logits, axis=-1)
+
+    args = (q_aval, s_aval, var_aval["batch_stats"],
+            _sds((B, R, R, R // 8), np.uint8))
+    return ProgramSpec(
+        name="serve_packed_int8",
+        fn=serve,
+        abstract_args=args,
+        precision="int8",
+        in_shardings=(rt.rep, rt.rep, rt.rep, _packed_sharding(rt)),
+        meta={"kind": "serve_packed_int8", "avals": _meta_avals(args)},
+    )
+
+
+PROGRAMS: dict[str, tuple[Callable, str, Callable[[Config], bool]]] = {
+    "init": (_spec_init, "sharded state init", _always),
+    "train_step": (_spec_train_step,
+                   "one fused fwd+bwd+optimizer+BN step", _always),
+    "multi_train_step": (
+        _spec_multi_train_step, "k train steps fused into one executable",
+        lambda cfg: cfg.steps_per_dispatch > 1),
+    "hbm_train_step": (
+        _spec_hbm_train_step,
+        "train steps sampling batches from the HBM-resident split",
+        lambda cfg: cfg.hbm_cache),
+    "eval_step": (_spec_eval_step, "exact-sum eval forward", _always),
+    "serve": (_spec_serve, "serving forward, fp32 weights", _always),
+    "serve_int8": (_spec_serve_int8,
+                   "serving forward, int8 per-channel weights", _always),
+    "serve_packed": (
+        _spec_serve_packed, "packed-wire serving forward (bench/mesh)",
+        lambda cfg: cfg.task == "classify"),
+    "serve_packed_int8": (
+        _spec_serve_packed_int8,
+        "packed-wire serving forward, int8 weights",
+        lambda cfg: cfg.task == "classify"),
+}
+
+# Programs warmup() skips without extra arguments: the resident-split
+# shapes only exist once the dataset is materialized.
+_NEEDS_RUNTIME_ARGS = frozenset({"hbm_train_step"})
+
+
+def list_programs(cfg: Config) -> list[dict]:
+    """Enumerate the catalog for ``cfg`` WITHOUT building anything — the
+    ``cli programs`` listing (name, doc, precision, applicability)."""
+    rows = []
+    for name, (_, doc, applicable) in PROGRAMS.items():
+        rows.append({
+            "program": name,
+            "doc": doc,
+            "precision": "int8" if name.endswith("int8") else "fp32",
+            "applicable": bool(applicable(cfg)),
+        })
+    return rows
+
+
+class Runtime:
+    """Per-config runtime context: model, mesh, shardings, and the
+    compiled-program front door (``build`` / ``warmup`` / ``programs``).
+
+    The Trainer, the Predictor, and the benchmark all construct one of
+    these; what each of them compiles is by construction the same program
+    the others would."""
+
+    def __init__(self, cfg: Config, mesh=None, spatial: Optional[bool] = None,
+                 cache=_FROM_CONFIG):
+        import jax.numpy as jnp
+
+        from featurenet_tpu.data.synthetic import WIRE_KEYS
+        from featurenet_tpu.parallel.mesh import (
+            batch_shardings,
+            clamp_model_axis,
+            make_mesh,
+            replicated,
+            state_shardings,
+        )
+        from featurenet_tpu.train.state import create_state
+        from featurenet_tpu.train.steps import make_optimizer
+
+        self.cfg = cfg.validate()
+        self.spatial = cfg.spatial if spatial is None else spatial
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            model_axis = clamp_model_axis(cfg.mesh_model, len(jax.devices()))
+            if model_axis != cfg.mesh_model:
+                # Presets carry pod-scale mesh shapes; on smaller hardware
+                # degrade to the widest feasible model axis instead of
+                # refusing to start.
+                obs.warn(
+                    "mesh_warning",
+                    f"mesh_model={cfg.mesh_model} does not divide the "
+                    f"{len(jax.devices())} available device(s); running "
+                    f"with mesh_model={model_axis}",
+                )
+            self.mesh = make_mesh(cfg.mesh_data, model_axis)
+        self.model = build_model(cfg)
+        self.tx = make_optimizer(cfg)
+        R = cfg.resolution
+        sample_shape = (cfg.global_batch, R, R, R, 1)
+
+        def init_fn(rng):
+            sample = jnp.zeros(sample_shape, jnp.float32)
+            return create_state(self.model, self.tx, sample, rng)
+
+        self._init_fn = init_fn
+        self.abstract_state = jax.eval_shape(init_fn, _key_aval())
+        self.state_sh = state_shardings(self.abstract_state, self.mesh)
+        self.batch_sh = batch_shardings(
+            self.mesh, spatial=self.spatial, keys=WIRE_KEYS[cfg.task]
+        )
+        self.rep = replicated(self.mesh)
+        self.cache: Optional[ExecutableCache] = (
+            cache_from_config(cfg) if cache is _FROM_CONFIG else cache
+        )
+        self._abstract_variables = None
+        # Fingerprint identity: the full config identity fields (arch
+        # INCLUDING conv_backend — a different lowering is a different
+        # executable) plus the mesh/layout decisions baked into shardings.
+        ident = config_to_dict(cfg)
+        self._identity = {f: ident[f] for f in IDENTITY_FIELDS}
+        self._identity["mesh"] = dict(self.mesh.shape)
+        self._identity["spatial"] = bool(self.spatial)
+
+    # -- shared abstract structures ------------------------------------------
+    def batch_avals(self) -> dict:
+        """Abstract wire batch (``data.synthetic.to_wire`` format) at the
+        config's global batch."""
+        cfg = self.cfg
+        B, R = cfg.global_batch, cfg.resolution
+        avals = {
+            "voxels": _sds((B, R, R, R // 8), np.uint8),
+            "mask": _sds((B,), np.float32),
+        }
+        if cfg.task == "segment":
+            avals["seg"] = _sds((B, R, R, R), np.int8)
+        else:
+            avals["label"] = _sds((B,), np.int32)
+        return avals
+
+    def abstract_variables(self) -> dict:
+        """Abstract ``{"params", "batch_stats"}`` of a bare ``model.init``
+        (what the packed serving programs take)."""
+        if self._abstract_variables is None:
+            import jax.numpy as jnp
+
+            R = self.cfg.resolution
+            sample = _sds((1, R, R, R, 1), jnp.float32)
+            self._abstract_variables = jax.eval_shape(
+                lambda rng, x: self.model.init(rng, x, train=False),
+                _key_aval(), sample,
+            )
+        return self._abstract_variables
+
+    def step_kwargs(self) -> dict:
+        """The train-step construction knobs shared by every train program
+        (single, fused, HBM-resident) — one source so they cannot drift."""
+        cfg = self.cfg
+        return dict(
+            label_smoothing=cfg.label_smoothing,
+            augment_groups=(
+                cfg.augment_groups if cfg.device_augment else 0
+            ),
+            packed=True,
+            seg_loss=cfg.seg_loss,
+            augment_noise=cfg.augment_noise,
+            augment_affine=cfg.augment_affine,
+            affine_opts=dict(
+                prob=cfg.augment_affine_prob,
+                ramp_steps=cfg.augment_ramp_steps,
+                rotate=cfg.augment_affine_rotate,
+                scale_range=cfg.augment_scale_range,
+                translate_vox=cfg.augment_translate_vox,
+            ),
+        )
+
+    def dispatch_k(self, params_n: int) -> int:
+        """The fused-dispatch width this config actually runs: the
+        requested ``steps_per_dispatch`` clamped against the analytic HBM
+        byte model (``ops/membytes``) — degrade with a warning, never
+        crash, never silently under-dispatch. An explicit CLI request
+        (``clamp_dispatch_k=False``) is honored with the OOM-risk
+        warning."""
+        cfg = self.cfg
+        k = max(1, cfg.steps_per_dispatch)
+        if k <= 1:
+            return k
+        from featurenet_tpu.ops.membytes import max_feasible_k
+
+        k_fit = max_feasible_k(cfg, params_n, n_rows=hbm_rows_estimate(cfg))
+        if k_fit < k and cfg.clamp_dispatch_k:
+            obs.warn(
+                "dispatch_warning",
+                f"steps_per_dispatch={cfg.steps_per_dispatch} does not "
+                f"fit the analytic HBM byte model for this config; "
+                f"clamped to {k_fit} (ops/membytes.max_feasible_k)",
+            )
+            return k_fit
+        if k_fit < k:
+            obs.warn(
+                "dispatch_warning",
+                f"steps_per_dispatch={cfg.steps_per_dispatch} exceeds "
+                f"the analytic HBM byte model's k={k_fit} but was "
+                "requested explicitly (clamp_dispatch_k=False); "
+                "honoring it — the fused executable may OOM",
+            )
+        return k
+
+    # -- the front door ------------------------------------------------------
+    def programs(self) -> list[str]:
+        """The program names this config can build, catalog order."""
+        return [
+            name for name, (_, _, applicable) in PROGRAMS.items()
+            if applicable(self.cfg)
+        ]
+
+    def spec(self, name: str, **kw) -> ProgramSpec:
+        if name not in PROGRAMS:
+            raise KeyError(
+                f"unknown program {name!r}; have {sorted(PROGRAMS)}"
+            )
+        builder, _, applicable = PROGRAMS[name]
+        if not applicable(self.cfg):
+            raise ValueError(
+                f"program {name!r} is not applicable to config "
+                f"{self.cfg.name!r} (see runtime.registry.PROGRAMS)"
+            )
+        return builder(self, **kw)
+
+    def build(self, name: str, **kw) -> CompiledProgram:
+        """``build → lower → compile`` with the guarded cache in front:
+        a verified cache hit skips XLA entirely; a miss compiles and
+        stores; any reject compiles fresh and says why."""
+        spec = self.spec(name, **kw)
+        t0 = time.perf_counter()
+        jitted = jax.jit(spec.fn, **spec.jit_kwargs())
+        lowered = jitted.lower(*spec.abstract_args)
+        compiled = None
+        source = "fresh"
+        fp = digest = None
+        if self.cache is not None:
+            fp = program_fingerprint(spec.name, self._identity, spec.meta)
+            digest = meta_digest(spec.meta, self._identity)
+            compiled, reason = self.cache.load(spec.name, fp, digest, lowered)
+            if reason == "hit":
+                source = "cache"
+                obs.emit("cache_hit", program=spec.name)
+            elif reason == "miss":
+                obs.emit("cache_miss", program=spec.name)
+            else:
+                # Stale fingerprint, torn file, failed/refused probe — the
+                # fresh compile below is the degradation path; the event
+                # is the record that the cache did NOT serve this program.
+                obs.emit("cache_reject", program=spec.name, reason=reason)
+        if compiled is None:
+            t1 = time.perf_counter()
+            compiled = self._compile(lowered)
+            obs.emit(
+                "program_compile", program=spec.name,
+                dur_s=round(time.perf_counter() - t1, 3),
+                precision=spec.precision,
+            )
+            if self.cache is not None:
+                self.cache.store(spec.name, fp, digest, compiled, spec.meta)
+        return CompiledProgram(
+            spec, compiled, source, round(time.perf_counter() - t0, 3)
+        )
+
+    def _compile(self, lowered):
+        """``lowered.compile()``, with jax's OWN persistent compilation
+        cache suspended while the exec cache will store the result: an
+        executable jax deserialized from its cache re-serializes into a
+        blob whose compiled symbols are missing ("Symbols not found" at
+        deserialize — the probe guard rejects every such entry), so a
+        stored payload must always come from a real XLA compile. With the
+        exec cache configured, it subsumes jax's cache anyway; without
+        one, jax's cache behavior is untouched."""
+        if self.cache is None:
+            return lowered.compile()
+        import jax as _jax
+        from jax._src import compilation_cache as _cc
+
+        prev = bool(_jax.config.jax_enable_compilation_cache)
+        if not prev:
+            return lowered.compile()
+        # The enable flag is only consulted when the cache object
+        # initializes, so each flip must be paired with reset_cache().
+        _jax.config.update("jax_enable_compilation_cache", False)
+        _cc.reset_cache()
+        try:
+            return lowered.compile()
+        finally:
+            _jax.config.update("jax_enable_compilation_cache", True)
+            _cc.reset_cache()
+
+    def warmup(self, names: Optional[list[str]] = None) -> dict[str, dict]:
+        """Build every (requested) applicable program — the AOT warmup a
+        serving process runs before taking traffic, and the path that
+        populates a cold executable cache. Returns per-program build
+        records; programs needing runtime-only arguments (the resident
+        HBM split) are reported skipped, not errored."""
+        out: dict[str, dict] = {}
+        for name in (names if names is not None else self.programs()):
+            if name in _NEEDS_RUNTIME_ARGS:
+                out[name] = {"skipped": "needs resident-split arrays"}
+                continue
+            prog = self.build(name)
+            out[name] = {
+                "source": prog.source,
+                "build_s": prog.build_s,
+                "precision": prog.precision,
+            }
+        return out

@@ -43,6 +43,42 @@ class InjectedFaultMisfire(RuntimeError):
     the chaos layer itself, never swallowed."""
 
 
+class ChecksumMismatch(RuntimeError):
+    """A checkpoint's bytes no longer match the checksum sidecar written
+    at save time — silent corruption (bit rot, a torn copy, a partial
+    overwrite that kept the file sizes). Raised BEFORE Orbax touches the
+    step, so the existing walk-back fallback treats it exactly like a
+    truncated step: resume falls back to the previous retained step; an
+    explicitly requested step propagates the error."""
+
+
+# --- checkpoint content verification -----------------------------------------
+# A checksum sidecar (`checksum.<step>.json` next to the step dirs) is
+# written once the async save finalizes and verified on restore before
+# Orbax reads a byte. Orbax's own failure mode is structural (missing /
+# truncated files); the sidecar catches the silent kind — same-size
+# corruption restores into structurally-valid garbage weights.
+
+def _checksum_path(root: str, step: int) -> str:
+    return os.path.join(root, f"checksum.{step}.json")
+
+
+def _dir_checksums(step_dir: str) -> dict[str, str]:
+    """relative path -> sha256 for every file under a finalized step dir."""
+    import hashlib
+
+    out: dict[str, str] = {}
+    for dirpath, _, files in os.walk(step_dir):
+        for name in files:
+            path = os.path.join(dirpath, name)
+            h = hashlib.sha256()
+            with open(path, "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    h.update(chunk)
+            out[os.path.relpath(path, step_dir)] = h.hexdigest()
+    return out
+
+
 def _step_dir(root: str, step: int) -> Optional[str]:
     """The on-disk directory Orbax keeps ``step`` in (naming varies with
     step_prefix/padding options across Orbax versions, so probe)."""
@@ -79,12 +115,67 @@ class CheckpointManager:
         self._config = config
         self._saves = 0
         self._restores = 0
+        # Steps whose async save has been enqueued but whose checksum
+        # sidecar is not yet written (it can only be computed once the
+        # background write finalizes — see _flush_checksums).
+        self._pending_sums: list[int] = []
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True, enable_async_checkpointing=True
             ),
         )
+
+    def _flush_checksums(self) -> None:
+        """Write the checksum sidecar for every finalized pending step and
+        GC sidecars of steps Orbax has retired. Called after any
+        wait_until_finished — never on the save critical path."""
+        for step in self._pending_sums:
+            target = _step_dir(self._dir, step)
+            if target is None:
+                continue  # already GC'd by retention
+            try:
+                with open(_checksum_path(self._dir, step), "w") as fh:
+                    json.dump(_dir_checksums(target), fh)
+            except OSError:
+                pass  # sidecar is belt-and-suspenders, never load-bearing
+        self._pending_sums = []
+        try:
+            kept = {int(s) for s in self._mgr.all_steps()}
+            for name in os.listdir(self._dir):
+                if name.startswith("checksum.") and name.endswith(".json"):
+                    digits = name[len("checksum."):-len(".json")]
+                    if digits.isdigit() and int(digits) not in kept:
+                        os.unlink(os.path.join(self._dir, name))
+        except OSError:
+            pass
+
+    def _verify_checksums(self, step: int) -> None:
+        """Raise ``ChecksumMismatch`` when the step's bytes disagree with
+        its sidecar; silently pass for legacy dirs without one."""
+        path = _checksum_path(self._dir, step)
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as fh:
+                expected = json.load(fh)
+        except (OSError, ValueError):
+            return  # unreadable sidecar: fall through to Orbax's own checks
+        target = _step_dir(self._dir, step)
+        if target is None:
+            return
+        actual = _dir_checksums(target)
+        if actual != expected:
+            bad = sorted(
+                set(expected) ^ set(actual)
+                | {k for k in expected
+                   if actual.get(k) not in (None, expected[k])}
+            )
+            raise ChecksumMismatch(
+                f"checkpoint step {step} fails content verification "
+                f"({len(bad)} file(s) differ from the save-time sidecar, "
+                f"e.g. {bad[:3]})"
+            )
 
     def _write_config(self) -> None:
         if self._config is None or jax.process_index() != 0:
@@ -100,6 +191,12 @@ class CheckpointManager:
 
     def save(self, state: TrainState, step: Optional[int] = None) -> None:
         step = int(state.step) if step is None else step
+        if self._pending_sums:
+            # The previous async save must finalize before its sidecar can
+            # be computed (Orbax serializes consecutive saves anyway, so
+            # this wait is not new latency on the step path).
+            self._mgr.wait_until_finished()
+            self._flush_checksums()
         self._write_config()
         payload = {
             "step": state.step,
@@ -118,11 +215,15 @@ class CheckpointManager:
                 # instead of as unexplained "other" time.
                 time.sleep(faults.SLOW_SLEEP_S)
             self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        self._pending_sums.append(step)
         if faults.maybe_fail("checkpoint_corrupt", save=self._saves):
             # Wait for the async write to finalize, then truncate the step
             # dir — the on-disk shape of a crash landing mid-checkpoint.
+            # The sidecar deliberately has NOT been written yet (pending
+            # flush): a crash mid-write leaves no checksum either.
             self._mgr.wait_until_finished()
             _corrupt_step_dir(self._dir, step)
+            self._pending_sums.remove(step)
 
     def restore(self, state: TrainState, step: Optional[int] = None,
                 cleanup: bool = False) -> TrainState:
@@ -170,6 +271,11 @@ class CheckpointManager:
                     raise faults.InjectedFault(
                         f"checkpoint_restore_error at step {s}"
                     )
+                # Content verification BEFORE Orbax reads a byte: silent
+                # same-size corruption would otherwise restore into
+                # structurally-valid garbage weights. A mismatch joins the
+                # existing truncation fallback below.
+                self._verify_checksums(s)
                 with obs.span("checkpoint_restore", step=s):
                     restored = self._mgr.restore(
                         s, args=ocp.args.StandardRestore(abstract)
@@ -200,6 +306,10 @@ class CheckpointManager:
                             d = _step_dir(self._dir, bad)
                             if d:
                                 shutil.rmtree(d, ignore_errors=True)
+                        try:
+                            os.unlink(_checksum_path(self._dir, bad))
+                        except OSError:
+                            pass
                 obs.emit("checkpoint_fallback", from_step=candidates[0],
                          to_step=s, error=repr(first_error)[:300])
                 print(json.dumps({
@@ -231,6 +341,13 @@ class CheckpointManager:
     def wait(self) -> None:
         with obs.span("checkpoint_wait"):
             self._mgr.wait_until_finished()
+        self._flush_checksums()
 
     def close(self) -> None:
+        # A save() + close() caller (no wait()) must not leave its last
+        # step checksum-less: finalize the in-flight async save and flush
+        # sidecars while the manager can still answer all_steps().
+        if self._pending_sums:
+            self._mgr.wait_until_finished()
+            self._flush_checksums()
         self._mgr.close()

@@ -32,59 +32,15 @@ from featurenet_tpu.data.dataset import (
     prefetch_to_device,
     put_batch,
 )
-from featurenet_tpu.models.featurenet import FeatureNet
-from featurenet_tpu.models.segmenter import FeatureNetSegmenter
-from featurenet_tpu.parallel.mesh import (
-    batch_shardings,
-    clamp_model_axis,
-    feed_shards,
-    make_mesh,
-    replicated,
-    state_shardings,
-)
+from featurenet_tpu.parallel.mesh import feed_shards
+# build_model lives in the runtime registry now (the single source shared
+# by Trainer, Predictor, and every registry program); re-exported here for
+# the callers that import it from the loop (seg_diagnose, older tests).
+from featurenet_tpu.runtime.registry import Runtime, build_model  # noqa: F401
 from featurenet_tpu.train.checkpoint import CheckpointManager
-from featurenet_tpu.train.state import TrainState, create_state, param_count
-from featurenet_tpu.train.steps import (
-    aggregate_eval,
-    make_eval_step,
-    make_hbm_multi_train_step,
-    make_multi_train_step,
-    make_optimizer,
-    make_train_step,
-)
+from featurenet_tpu.train.state import TrainState, param_count
+from featurenet_tpu.train.steps import aggregate_eval
 from featurenet_tpu.utils.logging import MetricLogger
-
-
-def _hbm_rows_estimate(cfg: Config) -> int:
-    """Train-split row count that ``hbm_cache`` mode will hold resident —
-    read from the cache's index metadata (cheap; the dataset itself is not
-    built yet when the dispatch-k clamp needs this)."""
-    if not (cfg.hbm_cache and cfg.data_cache):
-        return 0
-    import json
-    import os
-
-    try:
-        with open(os.path.join(cfg.data_cache, "index.json")) as fh:
-            index = json.load(fh)
-        if index.get("kind") == "segment":
-            total = sum(s["count"] for s in index["shards"])
-        else:
-            total = sum(index["counts"].values())
-        return int(total * (1.0 - cfg.test_fraction))
-    except (OSError, KeyError, ValueError):
-        return 0  # the Trainer's own cache open will raise the real error
-
-
-def build_model(cfg: Config):
-    if cfg.task == "segment":
-        return FeatureNetSegmenter(
-            features=tuple(cfg.seg_features),
-            input_context=cfg.seg_input_context,
-            decoder_blocks=cfg.seg_decoder_blocks,
-            bottleneck_blocks=cfg.seg_bottleneck_blocks,
-        )
-    return FeatureNet(arch=cfg.arch)
 
 
 class Trainer:
@@ -121,24 +77,16 @@ class Trainer:
                 self.cfg.inject_faults,
                 state_dir=self.cfg.run_dir or self.cfg.checkpoint_dir,
             )
-        if mesh is not None:
-            self.mesh = mesh
-        else:
-            model = clamp_model_axis(cfg.mesh_model, len(jax.devices()))
-            if model != cfg.mesh_model:
-                # Presets carry pod-scale mesh shapes; on smaller hardware
-                # degrade to the widest feasible model axis instead of
-                # refusing to start.
-                obs.warn(
-                    "mesh_warning",
-                    f"mesh_model={cfg.mesh_model} does not divide the "
-                    f"{len(jax.devices())} available device(s); running "
-                    f"with mesh_model={model}",
-                )
-            self.mesh = make_mesh(cfg.mesh_data, model)
-        self.spatial = cfg.spatial if spatial is None else spatial
-        self.model = build_model(cfg)
-        self.tx = make_optimizer(cfg)
+        # The runtime registry (featurenet_tpu.runtime): mesh, shardings,
+        # and every compiled program this run dispatches — enumerable,
+        # rebuildable, and (with Config.exec_cache_dir) served from the
+        # persistent AOT executable cache so a supervisor respawn or
+        # preemption resume skips recompilation.
+        self.rt = Runtime(cfg, mesh=mesh, spatial=spatial)
+        self.mesh = self.rt.mesh
+        self.spatial = self.rt.spatial
+        self.model = self.rt.model
+        self.tx = self.rt.tx
         # TB events from host 0 only (multi-host runs would double-write).
         self.logger = MetricLogger(
             tb_dir=cfg.tb_dir if jax.process_index() == 0 else None
@@ -153,21 +101,11 @@ class Trainer:
 
         # --- sharded init ---------------------------------------------------
         # The sample batch is created *inside* the traced init so it is shape
-        # metadata only — never a host constant baked into the executable.
-        sample_shape = (
-            cfg.global_batch, cfg.resolution, cfg.resolution, cfg.resolution, 1
-        )
-        rng = jax.random.key(cfg.seed)
-
-        def init_fn(rng):
-            sample = jax.numpy.zeros(sample_shape, jax.numpy.float32)
-            return create_state(self.model, self.tx, sample, rng)
-
-        abstract = jax.eval_shape(init_fn, rng)
-        self.state_sh = state_shardings(abstract, self.mesh)
-        self.state: TrainState = jax.jit(
-            init_fn, out_shardings=self.state_sh
-        )(rng)
+        # metadata only — never a host constant baked into the executable;
+        # init is a registry program, so a tensor-parallel run materializes
+        # each kernel shard directly on its device.
+        self.state_sh = self.rt.state_sh
+        self.state: TrainState = self.rt.build("init")(jax.random.key(cfg.seed))
         self.params_n = param_count(self.state.params)
 
         # Warm start (fine-tune semantics): params + batch_stats from an
@@ -189,99 +127,37 @@ class Trainer:
             self.state = src.restore_init(self.state)
             src.close()
 
-        # --- compiled steps -------------------------------------------------
+        # --- compiled steps (runtime registry programs) ---------------------
         # Wire format: voxels travel bit-packed for both tasks (unpacked on
         # device inside the step); classify drops the per-voxel target,
         # segment ships int8 seg. Host→device bandwidth is the input
         # pipeline's scarce resource — 32x less of it than float32 batches.
-        packed = True
-        from featurenet_tpu.data.synthetic import WIRE_KEYS
-
-        self.batch_sh = batch_shardings(
-            self.mesh, spatial=self.spatial, keys=WIRE_KEYS[cfg.task]
-        )
-        rep = replicated(self.mesh)
+        # Sharding/donation decisions live in the registry's ProgramSpecs,
+        # so the bench and the Trainer can never compile different programs
+        # under one name.
+        self.batch_sh = self.rt.batch_sh
+        rep = self.rt.rep
         # Cache-backed classification augments on device (rotations inside
         # the compiled step); the host dataset then skips its rotation pass.
         self._device_aug = cfg.device_augment
-        step_kw = dict(
-            label_smoothing=cfg.label_smoothing,
-            augment_groups=cfg.augment_groups if self._device_aug else 0,
-            packed=packed,
-            seg_loss=cfg.seg_loss,
-            augment_noise=cfg.augment_noise,
-            augment_affine=cfg.augment_affine,
-            affine_opts=dict(
-                prob=cfg.augment_affine_prob,
-                ramp_steps=cfg.augment_ramp_steps,
-                rotate=cfg.augment_affine_rotate,
-                scale_range=cfg.augment_scale_range,
-                translate_vox=cfg.augment_translate_vox,
-            ),
-        )
-        self._train_step = jax.jit(
-            make_train_step(self.model, cfg.task, **step_kw),
-            in_shardings=(self.state_sh, self.batch_sh, rep),
-            out_shardings=(self.state_sh, rep),
-            donate_argnums=(0,),
-        )
+        # Train/eval programs build LAZILY on first dispatch (_program):
+        # an eval-only Trainer (the `eval` CLI, recalibration) must not
+        # pay a train-step compile, and a training run compiles its first
+        # step exactly when the old inline jit would have. Serving is the
+        # opposite tradeoff — the Predictor builds at construction, since
+        # startup-before-traffic is the warmup. With Config.exec_cache_dir
+        # set, either way lands on the persistent executable cache.
+        self._programs: dict[tuple, object] = {}
         # Pipelined dispatch: k steps fused into one executable; the host
         # dispatches once per k optimizer updates (bitwise-identical math,
-        # see make_multi_train_step). The single-step jit above stays for
-        # segment remainders (total % k) and as the k=1 path.
-        # The requested k is clamped against the analytic HBM byte model
-        # (ops/membytes.py): the k-fused executable's peak grows ~linearly
-        # with k, and the best seg64 model once lost 8× of its dispatch
-        # amortization to a hand-resolved compile-time OOM. Degrade with a
-        # warning — never crash, never silently under-dispatch. The clamp
-        # governs preset-derived defaults only: cfg.clamp_dispatch_k=False
-        # (set by the CLI for an explicit --steps-per-dispatch) honors the
-        # requested k, warning that it exceeds the first-order model —
-        # the model is first-order, and opting out of it is the operator's
-        # call (advisor r5).
-        self._k = max(1, cfg.steps_per_dispatch)
-        if self._k > 1:
-            from featurenet_tpu.ops.membytes import max_feasible_k
-
-            k_fit = max_feasible_k(
-                cfg, self.params_n, n_rows=_hbm_rows_estimate(cfg)
-            )
-            if k_fit < self._k and cfg.clamp_dispatch_k:
-                obs.warn(
-                    "dispatch_warning",
-                    f"steps_per_dispatch={cfg.steps_per_dispatch} does not "
-                    f"fit the analytic HBM byte model for this config; "
-                    f"clamped to {k_fit} (ops/membytes.max_feasible_k)",
-                )
-                self._k = k_fit
-            elif k_fit < self._k:
-                obs.warn(
-                    "dispatch_warning",
-                    f"steps_per_dispatch={cfg.steps_per_dispatch} exceeds "
-                    f"the analytic HBM byte model's k={k_fit} but was "
-                    "requested explicitly (clamp_dispatch_k=False); "
-                    "honoring it — the fused executable may OOM",
-                )
-        if self._k > 1:
-            self._multi_step = jax.jit(
-                make_multi_train_step(
-                    self.model, cfg.task, num_steps=self._k, **step_kw
-                ),
-                in_shardings=(
-                    self.state_sh, (self.batch_sh,) * self._k, rep
-                ),
-                out_shardings=(self.state_sh, rep),
-                donate_argnums=(0,),
-            )
-        self._eval_step = jax.jit(
-            make_eval_step(self.model, cfg.task, packed=packed),
-            in_shardings=(
-                self.state_sh.params,
-                self.state_sh.batch_stats,
-                self.batch_sh,
-            ),
-            out_shardings=rep,
-        )
+        # see make_multi_train_step). The single-step program stays for
+        # segment remainders (total % k) and as the k=1 path. The
+        # requested k is clamped against the analytic HBM byte model
+        # (Runtime.dispatch_k / ops/membytes.py) — degrade with a warning,
+        # never crash, never silently under-dispatch; an explicit CLI
+        # request (clamp_dispatch_k=False) is honored with the OOM-risk
+        # warning (advisor r5).
+        self._k = self.rt.dispatch_k(self.params_n)
         # Computed under jit with an output sharding (not device_put): a
         # multi-process mesh's replicated sharding spans non-addressable
         # devices, which device_put refuses but GSPMD computation handles.
@@ -401,30 +277,19 @@ class Trainer:
             # Augmentation in HBM mode is necessarily in-step (there is no
             # host pass): classify rotates voxels, segment rotates
             # voxels+seg jointly. cfg.device_augment is the single source
-            # of truth and covers the hbm_cache case.
-            def _hbm_jit(n_steps: int):
-                return jax.jit(
-                    make_hbm_multi_train_step(
-                        self.model, self.mesh, cfg.global_batch, cfg.task,
-                        cfg.label_smoothing,
-                        augment_groups=(
-                            cfg.augment_groups if self._device_aug else 0
-                        ),
-                        num_steps=n_steps,
-                        seg_loss=cfg.seg_loss,
-                        augment_noise=cfg.augment_noise,
-                        augment_affine=cfg.augment_affine,
-                        affine_opts=step_kw["affine_opts"],
-                    ),
-                    in_shardings=(self.state_sh, d_sh, d_sh, rep),
-                    out_shardings=(self.state_sh, rep),
-                    donate_argnums=(0,),
-                )
-
-            self._hbm_step_k = _hbm_jit(self._k)
+            # of truth and covers the hbm_cache case. The resident arrays
+            # carry the program's shapes, so the registry build takes them
+            # explicitly (an index estimate could round differently).
+            self._hbm_step_k = self.rt.build(
+                "hbm_train_step", num_steps=self._k,
+                data=self._hbm_data, targets=self._hbm_labels,
+            )
             # Remainder dispatches (total % k, segment cuts) run one step.
             self._hbm_step_1 = (
-                _hbm_jit(1) if self._k > 1 else self._hbm_step_k
+                self.rt.build(
+                    "hbm_train_step", num_steps=1,
+                    data=self._hbm_data, targets=self._hbm_labels,
+                ) if self._k > 1 else self._hbm_step_k
             )
             self.logger.log(0, {
                 "hbm_resident_rows": float(n_keep),
@@ -463,6 +328,17 @@ class Trainer:
 
             touch_heartbeat(self.cfg.heartbeat_file)
 
+    def _program(self, name: str, **kw):
+        """The Trainer's lazily-built registry programs, one build per
+        (name, kwargs) (Runtime.build → lower → compile or
+        executable-cache load) — a later call with different kwargs (e.g.
+        another fusion width) builds its own executable instead of
+        silently reusing the first one's."""
+        key = (name, tuple(sorted(kw.items())))
+        if key not in self._programs:
+            self._programs[key] = self.rt.build(name, **kw)
+        return self._programs[key]
+
     # ------------------------------------------------------------------
     def dispatch_group(self, stream, take: int):
         """Dispatch ``take`` train steps as one compiled call and return the
@@ -489,14 +365,14 @@ class Trainer:
             with obs.span("data_wait", take=take):
                 batches = tuple(next(stream) for _ in range(take))
             with obs.span("dispatch", take=take):
-                self.state, metrics = self._multi_step(
-                    self.state, batches, self._step_rng
-                )
+                self.state, metrics = self._program(
+                    "multi_train_step", num_steps=self._k
+                )(self.state, batches, self._step_rng)
         else:
             with obs.span("data_wait", take=1):
                 batch = next(stream)
             with obs.span("dispatch", take=1):
-                self.state, metrics = self._train_step(
+                self.state, metrics = self._program("train_step")(
                     self.state, batch, self._step_rng
                 )
         return metrics
@@ -598,9 +474,10 @@ class Trainer:
             it = iter(self.eval_data)
             batches = (next(it) for _ in range(self.cfg.eval_batches))
         sums = []
+        eval_step = self._program("eval_step")
         for host_batch in batches:
             batch = put_batch(host_batch, self.batch_sh)
-            s = self._eval_step(
+            s = eval_step(
                 self.state.params, self.state.batch_stats, batch
             )
             sums.append(s)

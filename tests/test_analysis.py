@@ -361,6 +361,60 @@ def test_config_cli_stale_exemption_when_field_reachable(tmp_path):
     assert "log_every" in findings[0].msg
 
 
+def _fixture_config_with_validate(accepted: str) -> str:
+    """A Config whose validate() restricts ``flavor`` to a literal set —
+    the choices-vs-validate drift fixtures."""
+    return (
+        _fixture_config("    flavor: str = 'a'\n")
+        + "\n"
+        + "    def validate(self):\n"
+        + f"        if self.flavor not in ({accepted}):\n"
+        + "            raise ValueError(self.flavor)\n"
+        + "        return self\n"
+    )
+
+
+def test_config_cli_choices_match_validate_passes(tmp_path):
+    _write(tmp_path, "config.py",
+           _fixture_config_with_validate("'a', 'b'"))
+    _write(tmp_path, "cli.py", _FIXTURE_CLI.format(
+        extra_flag="    p.add_argument(\"--flavor\", "
+                   "choices=['a', 'b'])",
+        keys="'resolution', 'flavor'",
+    ))
+    assert run_lint(str(tmp_path), rules=["config-cli"]) == []
+
+
+def test_config_cli_choices_drift_caught(tmp_path):
+    """The CLI offers a value validate() refuses (and misses one it
+    accepts): both directions are one drifted-set finding."""
+    _write(tmp_path, "config.py",
+           _fixture_config_with_validate("'a', 'b'"))
+    _write(tmp_path, "cli.py", _FIXTURE_CLI.format(
+        extra_flag="    p.add_argument(\"--flavor\", "
+                   "choices=['a', 'zz'])",
+        keys="'resolution', 'flavor'",
+    ))
+    findings = run_lint(str(tmp_path), rules=["config-cli"])
+    assert [f.check for f in findings] == ["choices_drift"]
+    assert "'zz'" in findings[0].msg and "'b'" in findings[0].msg
+    assert findings[0].line > 0
+
+
+def test_config_cli_missing_choices_caught(tmp_path):
+    """A validate()-restricted field whose flag doesn't narrow at all:
+    the invalid value parses and only explodes at validate time."""
+    _write(tmp_path, "config.py",
+           _fixture_config_with_validate("'a', 'b'"))
+    _write(tmp_path, "cli.py", _FIXTURE_CLI.format(
+        extra_flag='    p.add_argument("--flavor")',
+        keys="'resolution', 'flavor'",
+    ))
+    findings = run_lint(str(tmp_path), rules=["config-cli"])
+    assert [f.check for f in findings] == ["missing_choices"]
+    assert "flavor" in findings[0].msg
+
+
 # --- rule: spans (span-name drift) -------------------------------------------
 
 def _clean_span_source() -> str:
@@ -400,6 +454,39 @@ def test_spans_dead_category_when_call_site_deleted(tmp_path):
     findings = run_lint(str(tmp_path), rules=["spans"])
     assert [f.check for f in findings] == ["dead_category"]
     assert "'data_wait'" in findings[0].msg and findings[0].line == 0
+
+
+# --- rule: alerts (doc examples vs known_metrics) ----------------------------
+
+def test_alert_docs_clean_and_prose_exempt(tmp_path):
+    """Valid rule examples pass; prose comparisons with spaced operators
+    ('groups > 0') are not rule examples and never match."""
+    _write(tmp_path, "docs.py", '''\
+        """Set --alert-rules to e.g. data_wait_fraction>0.6:critical or
+        serving_p99_ms>20. Unrelated prose: augment_groups > 0 keeps
+        rotation on."""
+        HELP = "queue_depth<1:info fires when the pipeline starves"
+    ''')
+    assert run_lint(str(tmp_path), rules=["alerts"]) == []
+
+
+def test_alert_docs_unknown_metric_caught(tmp_path):
+    path = _write(tmp_path, "docs.py",
+                  '"""e.g. data_wait_fracton>0.6 starves."""\n')
+    findings = run_lint(str(tmp_path), rules=["alerts"])
+    assert [f.check for f in findings] == ["unknown_doc_metric"]
+    assert findings[0].path == path
+    assert "data_wait_fracton" in findings[0].msg
+
+
+def test_alert_docs_unknown_severity_and_suppression(tmp_path):
+    _write(tmp_path, "docs.py",
+           'A = "serving_p99_ms>20:panic"\n'
+           'B = "step_p99_ratio>4:urgent"'
+           '  # lint: allow-alert-doc(deliberate bad example)\n')
+    findings = run_lint(str(tmp_path), rules=["alerts"])
+    assert [f.check for f in findings] == ["unknown_doc_severity"]
+    assert "panic" in findings[0].msg
 
 
 def test_spans_non_literal_and_foreign_span_apis_exempt(tmp_path):
@@ -497,7 +584,7 @@ def test_rule_registry_populated_at_import():
 
     assert set(RULE_NAMES) == {
         "telemetry", "fault-sites", "host-sync", "hygiene", "config-cli",
-        "spans",
+        "spans", "alerts",
     }
     assert set(RULES) == set(RULE_NAMES)
 

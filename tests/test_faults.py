@@ -365,6 +365,110 @@ def test_all_checkpoints_corrupt_raises_chained(tmp_path):
     mgr.close()
 
 
+# --- checkpoint content verification (checksum sidecar) ----------------------
+
+def _flip_byte_same_size(step_dir: str) -> str:
+    """Silent corruption: flip one byte of the largest file, size kept —
+    the failure mode Orbax's structural checks cannot see."""
+    files = []
+    for dirpath, _, names in os.walk(step_dir):
+        files += [os.path.join(dirpath, n) for n in names]
+    target = max(files, key=os.path.getsize)
+    with open(target, "r+b") as fh:
+        data = bytearray(fh.read())
+        data[len(data) // 2] ^= 0xFF
+        fh.seek(0)
+        fh.write(data)
+    return target
+
+
+def test_checksum_sidecar_written_at_save(tmp_path):
+    from featurenet_tpu.train.checkpoint import (
+        CheckpointManager,
+        _checksum_path,
+    )
+
+    state = _tiny_state()
+    root = str(tmp_path / "ck")
+    mgr = CheckpointManager(root, keep=2)
+    mgr.save(state, step=1)
+    mgr.wait()
+    assert os.path.exists(_checksum_path(root, 1))
+    with open(_checksum_path(root, 1)) as fh:
+        sums = json.load(fh)
+    assert sums and all(len(v) == 64 for v in sums.values())
+    # An untouched checkpoint restores cleanly through the verification.
+    restored = mgr.restore(state)
+    assert int(restored.step) == int(state.step)
+    mgr.close()
+
+
+def test_silent_corruption_caught_by_checksum_with_fallback(tmp_path):
+    """Same-size byte flip in the latest step: the sidecar verification
+    fails it BEFORE Orbax restores garbage, and resume falls back to the
+    previous retained step with the existing checkpoint_fallback event."""
+    import jax.numpy as jnp
+
+    from featurenet_tpu.train.checkpoint import CheckpointManager, _step_dir
+
+    state = _tiny_state()
+    root = str(tmp_path / "ck")
+    mgr = CheckpointManager(root, keep=3)
+    mgr.save(state.replace(step=jnp.asarray(1, jnp.int32)), step=1)
+    mgr.wait()
+    mgr.save(state.replace(step=jnp.asarray(2, jnp.int32)), step=2)
+    mgr.wait()
+    _flip_byte_same_size(_step_dir(root, 2))
+
+    obs.init_run(str(tmp_path / "run"))
+    try:
+        restored = mgr.restore(state, cleanup=True)
+    finally:
+        obs.close_run()
+    assert int(restored.step) == 1
+    events = [json.loads(l) for l in
+              open(tmp_path / "run" / "events.jsonl")]
+    fb = [e for e in events if e["ev"] == "checkpoint_fallback"]
+    assert len(fb) == 1 and fb[0]["from_step"] == 2
+    assert "ChecksumMismatch" in fb[0].get("error", "")
+    mgr.close()
+
+
+def test_checksum_mismatch_on_explicit_step_raises(tmp_path):
+    from featurenet_tpu.train.checkpoint import (
+        CheckpointManager,
+        ChecksumMismatch,
+        _step_dir,
+    )
+
+    state = _tiny_state()
+    root = str(tmp_path / "ck")
+    mgr = CheckpointManager(root, keep=2)
+    mgr.save(state, step=1)
+    mgr.wait()
+    _flip_byte_same_size(_step_dir(root, 1))
+    with pytest.raises(ChecksumMismatch, match="content verification"):
+        mgr.restore(state, step=1)  # the caller named it: error, not swap
+    mgr.close()
+
+
+def test_legacy_dir_without_sidecar_restores(tmp_path):
+    from featurenet_tpu.train.checkpoint import (
+        CheckpointManager,
+        _checksum_path,
+    )
+
+    state = _tiny_state()
+    root = str(tmp_path / "ck")
+    mgr = CheckpointManager(root, keep=2)
+    mgr.save(state, step=1)
+    mgr.wait()
+    os.unlink(_checksum_path(root, 1))  # pre-sidecar checkpoint layout
+    restored = mgr.restore(state)
+    assert int(restored.step) == int(state.step)
+    mgr.close()
+
+
 # --- supervisor: backoff, spawn_fail, telemetry verdict, stall re-read -------
 
 def _records_log():

@@ -1,0 +1,369 @@
+"""Runtime registry + persistent executable cache + int8 serving path.
+
+Acceptance coverage for the compiled-program runtime (ISSUE 6):
+
+- registry enumeration builds every applicable program on CPU;
+- cache roundtrip (miss → compile+store → hit) with the guarded load;
+- corrupted / stale-fingerprint entries fall back to a FRESH COMPILE with
+  a ``cache_reject`` event — the sandbox-abort hazard's required
+  degradation, proven with no abort path reachable;
+- int8 serving agrees with the fp32 path within the paper's >= 96.7%
+  held-out target on synthetic data (deterministic seed → not flaky);
+- the Trainer / Predictor / benchmark entry points all build through the
+  registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from featurenet_tpu import obs
+from featurenet_tpu.config import get_config
+from featurenet_tpu.runtime import (
+    ExecutableCache,
+    Runtime,
+    list_programs,
+)
+from featurenet_tpu.runtime.cache import PROBE_ENV
+
+
+@pytest.fixture
+def run_events(tmp_path):
+    """An active obs run; returns a reader for its event stream."""
+    run_dir = tmp_path / "run"
+    obs.init_run(str(run_dir), process_index=0)
+    yield lambda: [
+        json.loads(line)
+        for line in open(run_dir / "events.jsonl", encoding="utf-8")
+    ]
+    obs.close_run()
+
+
+def _cache_events(events):
+    return [
+        (e["ev"], e.get("program"), e.get("reason"))
+        for e in events
+        if e["ev"] in ("cache_hit", "cache_miss", "cache_reject",
+                       "program_compile")
+    ]
+
+
+def _zeros_args(prog):
+    return jax.tree_util.tree_map(
+        lambda a: np.zeros(a.shape, a.dtype), prog.spec.abstract_args
+    )
+
+
+# --- enumeration -------------------------------------------------------------
+
+def test_enumeration_builds_every_applicable_program():
+    """The acceptance walk: every program the catalog lists for a config
+    (including the k-fused multi step) builds on CPU through warmup()."""
+    cfg = get_config("smoke16", steps_per_dispatch=2)
+    rt = Runtime(cfg, cache=None)
+    names = rt.programs()
+    assert names == [r["program"] for r in list_programs(cfg)
+                     if r["applicable"]]
+    assert "multi_train_step" in names and "serve_int8" in names
+    built = rt.warmup()
+    assert set(built) == set(names)
+    for name, rec in built.items():
+        if name == "hbm_train_step":
+            continue
+        assert rec["source"] == "fresh", (name, rec)
+        assert rec["build_s"] > 0
+
+
+def test_enumeration_gates_inapplicable_programs():
+    cfg = get_config("smoke16")  # k=1, no hbm, classify
+    rt = Runtime(cfg, cache=None)
+    assert "multi_train_step" not in rt.programs()
+    assert "hbm_train_step" not in rt.programs()
+    with pytest.raises(ValueError, match="not applicable"):
+        rt.spec("multi_train_step")
+    with pytest.raises(KeyError, match="unknown program"):
+        rt.spec("warp_drive")
+    seg = get_config("smoke16")
+    import dataclasses
+
+    seg = dataclasses.replace(seg, task="segment", num_features=2).validate()
+    assert "serve_packed" not in Runtime(seg, cache=None).programs()
+
+
+def test_hbm_program_requires_resident_arrays():
+    cfg = get_config("smoke16", steps_per_dispatch=2)
+    rt = Runtime(cfg, cache=None)
+    # warmup() must SKIP (not crash on) the resident-shape program when
+    # enumerating a hbm config; building it without arrays is an error.
+    with pytest.raises(ValueError, match="resident arrays"):
+        from featurenet_tpu.runtime.registry import _spec_hbm_train_step
+
+        _spec_hbm_train_step(rt, num_steps=1)
+
+
+# --- cache roundtrip + guarded degradation -----------------------------------
+
+def test_cache_roundtrip_hit_serves_working_program(tmp_path, run_events):
+    """miss → compile+store, then a NEW Runtime loads the entry and the
+    deserialized program computes the same answer."""
+    cfg = get_config("smoke16")
+    cache_dir = str(tmp_path / "exec")
+    x = np.random.default_rng(0).random((4, 16, 16, 16, 1)).astype(
+        np.float32
+    )
+
+    rt = Runtime(cfg, cache=ExecutableCache(cache_dir))
+    p1 = rt.build("serve", batch=4)
+    assert p1.source == "fresh"
+    params = jax.tree_util.tree_map(
+        lambda a: np.zeros(a.shape, a.dtype), rt.abstract_state.params
+    )
+    stats = jax.tree_util.tree_map(
+        lambda a: np.zeros(a.shape, a.dtype), rt.abstract_state.batch_stats
+    )
+    ref = np.asarray(p1(params, stats, x))
+
+    rt2 = Runtime(cfg, cache=ExecutableCache(cache_dir))
+    p2 = rt2.build("serve", batch=4)
+    assert p2.source == "cache"
+    np.testing.assert_allclose(np.asarray(p2(params, stats, x)), ref)
+
+    kinds = _cache_events(run_events())
+    assert ("cache_miss", "serve", None) in kinds
+    assert ("cache_hit", "serve", None) in kinds
+    # the hit skipped XLA: exactly one compile for the two builds
+    assert sum(k[0] == "program_compile" for k in kinds) == 1
+
+
+def test_corrupted_entry_degrades_to_fresh_compile(tmp_path, run_events):
+    """The load-bearing hazard path: a torn cache entry must emit
+    cache_reject and compile fresh — never crash, never abort."""
+    cfg = get_config("smoke16")
+    cache_dir = str(tmp_path / "exec")
+    rt = Runtime(cfg, cache=ExecutableCache(cache_dir))
+    rt.build("serve", batch=4)
+    entry = [f for f in os.listdir(cache_dir) if f.endswith(".jexec")]
+    assert len(entry) == 1
+    path = os.path.join(cache_dir, entry[0])
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 3)
+
+    rt2 = Runtime(cfg, cache=ExecutableCache(cache_dir))
+    p = rt2.build("serve", batch=4)
+    assert p.source == "fresh"  # degraded, and the program still works
+    out = p(*_zeros_args(p))
+    assert np.asarray(out).shape == (4, 24)
+    rejects = [k for k in _cache_events(run_events())
+               if k[0] == "cache_reject"]
+    # Truncation into the payload surfaces at the subprocess probe; into
+    # the header, at the file parse — both are the guarded degradation.
+    assert rejects and rejects[0][2].split(":")[0] in (
+        "corrupt_entry", "probe_failed", "deserialize_error"
+    )
+    # the fresh compile REPLACED the torn entry: next build hits
+    p3 = Runtime(cfg, cache=ExecutableCache(cache_dir)).build(
+        "serve", batch=4
+    )
+    assert p3.source == "cache"
+    # Header-level corruption (torn magic/length) is caught before any
+    # subprocess spawns:
+    with open(path, "r+b") as fh:
+        fh.truncate(10)
+    p4 = Runtime(cfg, cache=ExecutableCache(cache_dir)).build(
+        "serve", batch=4
+    )
+    assert p4.source == "fresh"
+    assert any(r[2].startswith("corrupt_entry")
+               for r in _cache_events(run_events())
+               if r[0] == "cache_reject")
+
+
+def test_stale_fingerprint_rejects_and_recompiles(tmp_path, run_events):
+    """A jax upgrade / arch change lands on the same filename with a
+    different fingerprint: reject + overwrite, never a silent load."""
+    from featurenet_tpu.runtime.cache import MAGIC, _read_entry
+
+    cfg = get_config("smoke16")
+    cache_dir = str(tmp_path / "exec")
+    Runtime(cfg, cache=ExecutableCache(cache_dir)).build("serve", batch=4)
+    entry = [f for f in os.listdir(cache_dir) if f.endswith(".jexec")][0]
+    path = os.path.join(cache_dir, entry)
+    header, payload = _read_entry(path)
+    header["fingerprint"] = "deadbeef" * 8
+    raw = json.dumps(header).encode()
+    with open(path, "wb") as fh:
+        fh.write(MAGIC)
+        fh.write(len(raw).to_bytes(8, "little"))
+        fh.write(raw)
+        fh.write(payload)
+
+    p = Runtime(cfg, cache=ExecutableCache(cache_dir)).build(
+        "serve", batch=4
+    )
+    assert p.source == "fresh"
+    rejects = [k for k in _cache_events(run_events())
+               if k[0] == "cache_reject"]
+    assert ("cache_reject", "serve", "stale_fingerprint") in rejects
+
+
+def test_probe_reject_env_gate(tmp_path, monkeypatch, run_events):
+    """FEATURENET_EXEC_CACHE_PROBE=reject: the env gate refuses every
+    load (known-bad sandbox mode) but stores keep working, and the build
+    degrades to fresh with the reject recorded."""
+    cfg = get_config("smoke16")
+    cache_dir = str(tmp_path / "exec")
+    monkeypatch.setenv(PROBE_ENV, "reject")
+    rt = Runtime(cfg, cache=ExecutableCache(cache_dir))
+    assert rt.build("serve", batch=4).source == "fresh"
+    assert any(f.endswith(".jexec") for f in os.listdir(cache_dir))
+    p2 = Runtime(cfg, cache=ExecutableCache(cache_dir)).build(
+        "serve", batch=4
+    )
+    assert p2.source == "fresh"
+    assert ("cache_reject", "serve", "probe_rejected") in _cache_events(
+        run_events()
+    )
+    with pytest.raises(ValueError, match="probe mode"):
+        ExecutableCache(str(tmp_path / "x"), probe="yolo")
+
+
+def test_no_cache_no_files(tmp_path):
+    """Default config (no exec_cache_dir): nothing serialized anywhere."""
+    cfg = get_config("smoke16")
+    rt = Runtime(cfg, cache=None)
+    assert rt.cache is None
+    from featurenet_tpu.runtime import cache_from_config
+
+    assert cache_from_config(cfg) is None
+    cfg2 = get_config("smoke16",
+                      exec_cache_dir=str(tmp_path / "from_cfg"))
+    assert cache_from_config(cfg2) is not None
+    assert Runtime(cfg2).cache is not None
+
+
+# --- int8 serving path -------------------------------------------------------
+
+def test_quantize_per_channel_shapes_and_error_bound():
+    from featurenet_tpu.runtime.quantize import (
+        dequantize_tree,
+        quantize_tree,
+    )
+
+    rng = np.random.default_rng(0)
+    params = {
+        "Conv_0": {"kernel": rng.normal(0, 0.1, (3, 3, 3, 1, 8))
+                   .astype(np.float32) * np.logspace(-2, 0, 8),
+                   "bias": rng.normal(size=(8,)).astype(np.float32)},
+    }
+    q, s = quantize_tree(params)
+    assert q["Conv_0"]["kernel"].dtype == np.int8
+    assert s["Conv_0"]["kernel"].shape == (1, 1, 1, 1, 8)  # per-channel
+    assert q["Conv_0"]["bias"].dtype == np.float32  # vectors pass through
+    deq = dequantize_tree(q, s)
+    np.testing.assert_array_equal(deq["Conv_0"]["bias"],
+                                  params["Conv_0"]["bias"])
+    # per-channel symmetric int8: error <= scale/2 per element, even with
+    # channel dynamic ranges spanning two orders of magnitude
+    err = np.abs(np.asarray(deq["Conv_0"]["kernel"])
+                 - params["Conv_0"]["kernel"])
+    assert (err <= np.asarray(s["Conv_0"]["kernel"]) / 2 + 1e-7).all()
+
+
+def test_int8_serving_agreement_meets_paper_target():
+    """fp32 vs int8 top-1 agreement on synthetic held-out-style parts:
+    must clear the paper's 96.7% target (deterministic seeds)."""
+    from featurenet_tpu.infer import Predictor
+
+    cfg = get_config("smoke16")
+    rt = Runtime(cfg, cache=None)
+    state = rt.build("init")(jax.random.key(0))
+    p = Predictor(state.params, state.batch_stats, cfg, batch=8,
+                  precision="int8")
+    agreement = p.int8_agreement(n=48, seed=0)
+    assert agreement >= 0.967, f"int8 agreement {agreement} < paper target"
+
+
+def test_int8_predictor_matches_fp32_predictions():
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.infer import Predictor
+
+    cfg = get_config("smoke16")
+    rt = Runtime(cfg, cache=None)
+    state = rt.build("init")(jax.random.key(0))
+    grids = generate_batch(
+        np.random.default_rng(1), 6, cfg.resolution
+    )["voxels"]
+    fp = Predictor(state.params, state.batch_stats, cfg, batch=8)
+    i8 = Predictor(state.params, state.batch_stats, cfg, batch=8,
+                   precision="int8")
+    assert fp.precision == "fp32" and i8.precision == "int8"
+    lf, pf = fp.predict_voxels(grids)
+    l8, p8 = i8.predict_voxels(grids)
+    assert (lf == l8).mean() >= 0.967
+    np.testing.assert_allclose(pf, p8, atol=0.05)  # probs move, argmax not
+    with pytest.raises(ValueError, match="precision"):
+        Predictor(state.params, state.batch_stats, cfg, precision="fp8")
+
+
+@pytest.mark.slow
+def test_int8_serving_measurement_runs():
+    """Full converged-slope protocol over the int8 serving program (the
+    ≥3 s measurement windows dominate); the program itself builds in the
+    fast tier via enumeration + the Predictor int8 tests."""
+    from featurenet_tpu.benchmark import measure_inference
+
+    r = measure_inference(get_config("smoke16"), batch_per_chip=4,
+                          repeats=1, measure=2, precision="int8")
+    assert r["precision"] == "int8"
+    assert r["inferences_per_sec_per_chip"] > 0
+
+
+# --- entry points build through the registry ---------------------------------
+
+def test_trainer_builds_through_registry(tmp_path):
+    from featurenet_tpu.runtime.registry import CompiledProgram
+    from featurenet_tpu.train.loop import Trainer
+
+    cfg = get_config("smoke16", total_steps=2, eval_batches=1)
+    tr = Trainer(cfg)
+    assert tr.rt.mesh is tr.mesh and tr.rt.model is tr.model
+    assert tr._programs == {}  # lazy: nothing compiled before dispatch
+    tr.run()  # two steps end-to-end through the registry programs
+    assert isinstance(tr._program("train_step"), CompiledProgram)
+    assert isinstance(tr._program("eval_step"), CompiledProgram)
+    # Memoized per (name, kwargs): exactly these two programs were built.
+    assert {name for name, _ in tr._programs} == {"train_step", "eval_step"}
+
+
+def test_ttfs_warm_start_hits_cache(tmp_path):
+    """measure_ttfs: the warm build must actually come from the cache
+    (this is the headline the bench pins)."""
+    from featurenet_tpu.benchmark import measure_ttfs
+
+    t = measure_ttfs(get_config("smoke16"), batch_per_chip=4)
+    assert t["ttfs_cold_s"] > 0 and t["ttfs_warm_s"] > 0
+    assert t["warm_source"] == "cache"
+
+
+def test_cli_programs_lists_and_warms(tmp_path, capsys):
+    from featurenet_tpu.cli import main
+
+    main(["programs", "--config", "smoke16"])
+    rows = [json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()]
+    names = {r["program"] for r in rows}
+    assert {"train_step", "serve", "serve_int8"} <= names
+    assert all({"doc", "precision", "applicable"} <= set(r) for r in rows)
+
+    cache_dir = str(tmp_path / "exec")
+    main(["programs", "--config", "smoke16", "--warm",
+          "--exec-cache-dir", cache_dir])
+    out = capsys.readouterr().out.strip().splitlines()
+    warm = json.loads(out[-1])["warmup"]
+    assert warm["serve"]["source"] == "fresh"
+    assert any(f.endswith(".jexec") for f in os.listdir(cache_dir))

@@ -144,6 +144,50 @@ def test_aggregator_emits_summaries_and_alerts(tmp_path):
     assert a["threshold"] == 0.5
     assert a["severity"] == "warning"
     assert a["window"] == s["seq"]  # same emission cycle
+    assert a["state"] == "fire"
+
+
+def test_alert_hysteresis_fire_resolve_pairs(tmp_path):
+    """A violation lasting N cycles is ONE fire; recovery is its paired
+    resolve; a second violation is a fresh pair — never per-cycle
+    re-fires (carried-over SLO follow-on)."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    agg = windows.WindowAggregator(
+        rules=alerts.parse_rules("data_wait_ms_max>50"),
+        window=4, emit_every_s=1e9,
+    )
+    windows.install(agg)
+
+    def cycle(value):
+        for _ in range(4):  # refill the 4-deep ring with one level
+            obs.observe("data_wait_ms", value)
+        windows.flush()
+
+    cycle(80.0)   # crossing in -> fire
+    cycle(90.0)   # still violated -> SILENT (the hysteresis)
+    cycle(10.0)   # recovered -> resolve
+    cycle(10.0)   # still healthy -> silent
+    cycle(70.0)   # second violation -> second fire
+    obs.close_run()
+
+    events, _ = load_events(run_dir)
+    assert validate_events(events) == []
+    transitions = [e["state"] for e in events if e["ev"] == "alert"]
+    assert transitions == ["fire", "resolve", "fire"]
+
+    # Report: last transition is an unresolved fire -> ACTIVE, counts
+    # split fires from resolves.
+    rep = build_report(events)
+    a = rep["slo"]["alerts"]["data_wait_ms_max"]
+    assert a["count"] == 2 and a["resolves"] == 1 and a["active"] is True
+    assert "ACTIVE data_wait_ms_max" in format_report(rep)
+    # Drop the trailing fire: the resolved pair alone reads recovered.
+    recovered = [e for e in events
+                 if not (e["ev"] == "alert" and e["t"] == max(
+                     x["t"] for x in events if x["ev"] == "alert"))]
+    a2 = build_report(recovered)["slo"]["alerts"]["data_wait_ms_max"]
+    assert a2["active"] is False
 
 
 def test_aggregator_periodic_emission_and_span_hook(tmp_path):
