@@ -85,28 +85,61 @@ def _window_gate_fields(run_dir: str) -> dict:
     return out
 
 
+# The probe child's whole job is to die informatively: it catches its OWN
+# backend-init failure (make_c_api_client raising JaxRuntimeError during
+# plugin init — the BENCH_r05 outage shape) and reports it as one JSON
+# line instead of a traceback, so the parent never has to scrape stderr
+# to stay parseable. BaseException on purpose: some plugin-init failures
+# raise SystemExit-adjacent types, and anything the child can still
+# format beats a raw abort.
+_PROBE_SRC = """\
+import json
+try:
+    import jax
+    print(json.dumps({"platform": jax.devices()[0].platform}))
+except BaseException as e:
+    print(json.dumps(
+        {"probe_error": (type(e).__name__ + ": " + str(e))[:1500]}
+    ))
+"""
+
+
 def _probe_backend() -> tuple[str, str | None]:
     """Ask — in a THROWAWAY subprocess — whether the default JAX backend
     comes up. In-process probing is unusable: a failed backend init
     poisons jax's cached backend state, and the BENCH_r05 outage showed
     the failure mode (a raw JaxRuntimeError traceback mid-run, an
-    unparseable artifact). Returns ``(platform, None)`` or
-    ``("", error_tail)``."""
+    unparseable artifact). The child answers in JSON either way (see
+    ``_PROBE_SRC``); a child that died too hard to answer — fatal abort,
+    signal, hang — degrades to its stderr tail. Returns
+    ``(platform, None)`` or ``("", error_detail)``; the caller turns the
+    latter into the structured ``{"skipped": true, ...}`` record, never
+    an unhandled traceback."""
     import subprocess
     import sys
 
     try:
         r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
+            [sys.executable, "-c", _PROBE_SRC],
             capture_output=True, text=True, timeout=300,
         )
     except Exception as e:  # timeout, spawn failure
         return "", str(e)
-    if r.returncode == 0 and r.stdout.strip():
-        return r.stdout.strip().splitlines()[-1], None
+    # Parse the child's JSON verdict (last parseable line: plugin noise
+    # may precede it on stdout).
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and rec.get("platform"):
+            return str(rec["platform"]), None
+        if isinstance(rec, dict) and "probe_error" in rec:
+            return "", str(rec["probe_error"])
     tail = (r.stderr or r.stdout or "").strip()
-    return "", tail[-1500:]
+    return "", tail[-1500:] or (
+        f"probe subprocess exited {r.returncode} with no output"
+    )
 
 
 def main() -> None:
@@ -234,6 +267,26 @@ def _measure_round(platform: str) -> dict:
     # ("cache") or degraded to a fresh compile ("fresh") — both are
     # honest artifacts.
     ttfs = measure_ttfs(cfg)
+    # Open-loop serving (featurenet_tpu.serve): Poisson arrivals through
+    # the continuous batcher + bucketed AOT executables — the number a
+    # real request stream sustains, vs the closed-loop packed-batch
+    # headline above that no traffic pattern can reach. Offered load =
+    # BENCH_LOAD_FRACTION of this session's measured closed-loop rate
+    # (deep enough to fill the big buckets, far from saturation), capped
+    # where a Python-thread generator stops being open-loop.
+    from featurenet_tpu.serve.loadgen import (
+        BENCH_LOAD_FRACTION,
+        BENCH_QPS_CAP,
+        bench_serving,
+    )
+
+    serve_row = bench_serving(
+        cfg,
+        qps=min(BENCH_QPS_CAP,
+                BENCH_LOAD_FRACTION
+                * serving["inferences_per_sec_per_chip"]),
+        n_requests=512,
+    )
     e2e = {}
     if os.path.isdir(E2E_CACHE):
         import tempfile
@@ -360,6 +413,10 @@ def _measure_round(platform: str) -> dict:
         ),
         "paper_arch_mfu": paper["mfu"],
         "paper_arch_spread_pct": paper["spread_pct"],
+        # Open-loop serving row (serve.loadgen.bench_serving): sustained
+        # QPS, end-to-end p50/p99 at the target load, mean batch
+        # occupancy of the bucket ladder, overload rejections.
+        **serve_row,
         **e2e,
     }
     # Self-policing (obs.gates): every round carries a pin-ready
@@ -385,6 +442,10 @@ def _measure_round(platform: str) -> dict:
     # load (seconds-scale), and a warm start that degraded to a fresh
     # compile (probe reject) should fail the pin by the COLD margin, not
     # by sub-second wiggle.
+    # The serve latency pins get absolute room like the window pins: at a
+    # healthy load p50 sits near the flush deadline (single-digit ms)
+    # where relative tolerance pins "never change"; serve_rejected's
+    # baseline is 0 by design, so only absolute slack is meaningful.
     for noisy, slack in (
         ("spread_pct", SPREAD_TOLERANCE_ABS),
         ("serving_spread_pct", SPREAD_TOLERANCE_ABS),
@@ -394,6 +455,9 @@ def _measure_round(platform: str) -> dict:
         ("window_data_wait_p50_ms", 1.0),
         ("window_data_wait_p99_ms", 5.0),
         ("window_queue_depth_p50", 1.0),
+        ("serve_p50_ms", 5.0),
+        ("serve_p99_ms", 15.0),
+        ("serve_rejected", 16.0),
     ):
         pin = out["gate_summary"]["gates"].get(noisy)
         if pin is not None:

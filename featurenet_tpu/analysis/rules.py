@@ -203,9 +203,12 @@ def fault_sites_rule(tree: Tree) -> list[Finding]:
 # host sync here serializes the pipeline, so each one must be deliberate
 # and say why. Package-relative paths. data/dataset.py is the consumer
 # path of the prefetcher — put_batch and the ticket loop run once per
-# dispatch group, so a stray readback there stalls every step.
+# dispatch group, so a stray readback there stalls every step. The serve
+# modules are the continuous batcher's dispatch thread and the service's
+# forward — a stray sync there is paid once per live batch.
 HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py",
-                    "data/dataset.py")
+                    "data/dataset.py", "serve/batcher.py",
+                    "serve/service.py")
 
 
 def _is_host_sync(node: ast.Call) -> Optional[str]:

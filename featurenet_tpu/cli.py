@@ -498,6 +498,80 @@ def main(argv=None) -> None:
     p_inf.add_argument("--run-dir", dest="run_dir",
                        help="observability directory: record per-batch "
                             "serving latency spans (see `cli report`)")
+    p_inf.add_argument("--alert-rules", dest="alert_rules",
+                       help="serving SLO rules "
+                            "'metric(>|<)threshold[:severity],...' "
+                            "(featurenet_tpu.obs.alerts) evaluated over "
+                            "this run's serving windows — e.g. "
+                            "'serving_p99_ms>20:critical'. An unresolved "
+                            "serving alert when the batch finishes makes "
+                            "infer EXIT 2, so CI can gate on latency "
+                            "regressions; requires --run-dir")
+    p_srv = sub.add_parser("serve", allow_abbrev=False,
+                           help="always-on inference service "
+                                "(featurenet_tpu.serve): HTTP front end "
+                                "feeding a continuous batcher over a "
+                                "ladder of pre-built serving executables; "
+                                "POST /predict with raw STL bytes, "
+                                "GET /stats for counters; overload "
+                                "fast-rejects with a structured 503")
+    p_srv.add_argument("--checkpoint-dir", required=True)
+    p_srv.add_argument("--config", default=None,
+                       help="only needed for legacy checkpoints without a "
+                            "persisted config.json")
+    p_srv.add_argument("--precision", choices=["fp32", "int8"],
+                       default="fp32",
+                       help="serving weight precision (see `infer`)")
+    p_srv.add_argument("--buckets", default="1,4,16,64",
+                       help="comma list of compiled batch shapes (the "
+                            "bucket ladder); every one is built AOT at "
+                            "startup so no request ever pays an XLA "
+                            "compile (default 1,4,16,64)")
+    p_srv.add_argument("--max-wait-ms", type=float, default=5.0,
+                       dest="max_wait_ms",
+                       help="continuous-batching flush deadline: a batch "
+                            "dispatches when the largest bucket fills OR "
+                            "the oldest request has waited this long "
+                            "(default 5)")
+    p_srv.add_argument("--queue-limit", type=int, default=64,
+                       dest="queue_limit",
+                       help="admission bound: requests beyond this queue "
+                            "depth are fast-rejected with a structured "
+                            "overload response instead of queueing "
+                            "without bound (default 64)")
+    p_srv.add_argument("--host", default="127.0.0.1")
+    p_srv.add_argument("--port", type=int, default=8000,
+                       help="HTTP port (0 = ephemeral; the bound port is "
+                            "printed in the startup line)")
+    p_srv.add_argument("--slo-p99-ms", type=float, default=250.0,
+                       dest="slo_p99_ms",
+                       help="end-to-end p99 latency SLO: installs "
+                            "'serving_p99_ms>SLO:critical' and "
+                            "'queue_wait_ms_p99>SLO' alert rules over "
+                            "the serving windows (default 250; "
+                            "--alert-rules replaces them entirely)")
+    p_srv.add_argument("--alert-rules", dest="alert_rules",
+                       help="full custom rule spec (see `infer "
+                            "--alert-rules`); replaces the --slo-p99-ms "
+                            "defaults")
+    p_srv.add_argument("--duration-s", type=float, default=None,
+                       dest="duration_s",
+                       help="serve for this many seconds then drain and "
+                            "exit (default: run until SIGTERM/SIGINT)")
+    p_srv.add_argument("--drain", action="store_true",
+                       help="gate the exit code on the SLO at drain time: "
+                            "exit 2 when a serving alert is still "
+                            "unresolved after the final flush (CI "
+                            "latency gate); without this flag the drain "
+                            "verdict is reported but the exit stays 0")
+    p_srv.add_argument("--run-dir", dest="run_dir",
+                       help="observability directory: serve_batch/"
+                            "overload events, window summaries, alert "
+                            "fire/resolve pairs (see `cli report`)")
+    p_srv.add_argument("--exec-cache-dir", dest="exec_cache_dir",
+                       help="persistent AOT executable cache: the bucket "
+                            "ladder's warmup deserializes instead of "
+                            "compiling on later cold starts")
     args = parser.parse_args(argv)
 
     if args.cmd == "programs":
@@ -943,11 +1017,28 @@ def main(argv=None) -> None:
                 f"(config {cfg.name!r} has task={cfg.task!r}); it would "
                 "silently produce no label grids"
             )
+        if args.alert_rules and not getattr(args, "run_dir", None):
+            raise SystemExit(
+                "infer: --alert-rules needs --run-dir (no run, no "
+                "windows — the rules would silently gate nothing)"
+            )
         if getattr(args, "run_dir", None):
             from featurenet_tpu import obs
             from featurenet_tpu.config import config_to_dict
 
             obs.init_run(args.run_dir, config=config_to_dict(cfg))
+            if args.alert_rules:
+                # Replace init_run's default-rule aggregator with the
+                # operator's serving SLO spec: these rules drive the
+                # exit code below.
+                from featurenet_tpu.obs import windows as _windows
+                from featurenet_tpu.obs.alerts import parse_rules
+
+                try:
+                    rules = parse_rules(args.alert_rules)
+                except ValueError as e:
+                    raise SystemExit(f"--alert-rules: {e}")
+                _windows.install(_windows.WindowAggregator(rules=rules))
         # Compile batch sized to the request: padding 1 STL to the default
         # 32 would run 32x the needed FLOPs (felt hardest by the
         # full-resolution segmentation decoder). Construction is the AOT
@@ -980,10 +1071,114 @@ def main(argv=None) -> None:
                 print(json.dumps(dataclasses.asdict(r)))
         if getattr(args, "run_dir", None):
             # Flush the serving-latency window summaries (a batch of STLs
-            # rarely outlives the emit period) and release the sink.
+            # rarely outlives the emit period), read the SLO verdict, and
+            # release the sink. An unresolved serving alert at this drain
+            # point exits 2 — the CI latency gate (carried-over SLO
+            # follow-on): `infer --run-dir D --alert-rules
+            # 'serving_p99_ms>20'` fails the pipeline when the tail blew.
+            from featurenet_tpu import obs
+            from featurenet_tpu.obs import windows as _windows
+            from featurenet_tpu.obs.alerts import is_serving_metric
+
+            _windows.flush()
+            stuck = [
+                m for m in _windows.active_alerts() if is_serving_metric(m)
+            ]
+            obs.close_run()
+            if stuck:
+                print(json.dumps({"serving_alerts_active": stuck}))
+                raise SystemExit(2)
+        return
+
+    if args.cmd == "serve":
+        import dataclasses as _dc
+        import signal
+        import threading
+
+        from featurenet_tpu.config import get_config
+        from featurenet_tpu.infer import Predictor
+        from featurenet_tpu.serve.batcher import normalize_buckets
+        from featurenet_tpu.serve.http import make_server
+        from featurenet_tpu.serve.service import InferenceService
+        from featurenet_tpu.train.checkpoint import load_run_config
+
+        # Fail the ladder spec here, before the (expensive) checkpoint
+        # load — but with the batcher's own validation, not a copy of it.
+        try:
+            buckets = normalize_buckets(
+                [int(b) for b in args.buckets.split(",") if b.strip()]
+            )
+        except ValueError:
+            raise SystemExit(
+                f"serve: --buckets must be comma-separated batch sizes "
+                f">= 1, got {args.buckets!r}"
+            )
+        saved = load_run_config(args.checkpoint_dir)
+        if saved is not None:
+            cfg = _cfg_from_checkpoint(saved, args)
+        else:
+            cfg = get_config(args.config or "pod64")
+        if args.exec_cache_dir:
+            cfg = _dc.replace(cfg, exec_cache_dir=args.exec_cache_dir)
+        rules = None  # None → the service installs serve_rules(slo_p99_ms)
+        if args.alert_rules:
+            from featurenet_tpu.obs.alerts import parse_rules
+
+            try:
+                rules = parse_rules(args.alert_rules)
+            except ValueError as e:
+                raise SystemExit(f"--alert-rules: {e}")
+        if getattr(args, "run_dir", None):
+            from featurenet_tpu import obs
+            from featurenet_tpu.config import config_to_dict
+
+            obs.init_run(args.run_dir, config=config_to_dict(cfg),
+                         extra={"cmd": "serve"})
+        # Construction IS the warmup: one serve executable per bucket
+        # builds (or loads from the exec cache) before the socket opens.
+        pred = Predictor.from_checkpoint(
+            args.checkpoint_dir, cfg, batch=max(buckets),
+            precision=args.precision,
+        )
+        service = InferenceService(
+            pred, buckets=buckets, max_wait_ms=args.max_wait_ms,
+            queue_limit=args.queue_limit, rules=rules,
+            slo_p99_ms=args.slo_p99_ms,
+        )
+        srv = make_server(service, host=args.host, port=args.port)
+        server_thread = threading.Thread(
+            target=srv.serve_forever, name="serve-http", daemon=True
+        )
+        server_thread.start()
+        print(json.dumps({"serving": {
+            "host": srv.server_address[0], "port": srv.server_address[1],
+            "buckets": list(buckets), "max_wait_ms": args.max_wait_ms,
+            "queue_limit": args.queue_limit, "precision": args.precision,
+            "endpoints": ["POST /predict", "GET /stats"],
+        }}), flush=True)
+        stop = threading.Event()
+        prev_handlers = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(
+                    sig, lambda *_: stop.set()
+                )
+            except ValueError:
+                pass  # non-main thread (embedded use): duration still works
+        try:
+            stop.wait(timeout=args.duration_s)
+        finally:
+            for sig, h in prev_handlers.items():
+                signal.signal(sig, h)
+        srv.shutdown()
+        st = service.drain()
+        if getattr(args, "run_dir", None):
             from featurenet_tpu import obs
 
             obs.close_run()
+        print(json.dumps({"serve_stats": st}))
+        if args.drain and st["exit_code"]:
+            raise SystemExit(st["exit_code"])
         return
 
     if getattr(args, "debug_nans", False):

@@ -11,7 +11,6 @@ a single ``float32 [n, 3, 3]`` vertex array (triangle-major, vertex-minor).
 
 from __future__ import annotations
 
-import os
 import struct
 
 import numpy as np
@@ -29,58 +28,63 @@ _RECORD_DTYPE = np.dtype(
 )
 
 
-def _is_binary_stl(path: str) -> bool:
+def _is_binary_stl(data: bytes) -> bool:
     """Decide binary vs ASCII by record arithmetic, not by the 'solid' prefix.
 
     Many binary exporters write headers that begin with ``solid``, so the only
-    reliable test is whether the file size matches the binary layout.
+    reliable test is whether the payload size matches the binary layout.
     """
-    size = os.path.getsize(path)
-    if size < _BINARY_HEADER_BYTES + 4:
+    if len(data) < _BINARY_HEADER_BYTES + 4:
         return False
-    with open(path, "rb") as f:
-        f.seek(_BINARY_HEADER_BYTES)
-        (count,) = struct.unpack("<I", f.read(4))
-    return size == _BINARY_HEADER_BYTES + 4 + count * _RECORD_BYTES
+    (count,) = struct.unpack_from("<I", data, _BINARY_HEADER_BYTES)
+    return len(data) == _BINARY_HEADER_BYTES + 4 + count * _RECORD_BYTES
+
+
+def parse_stl(data: bytes) -> np.ndarray:
+    """Parse STL bytes (binary or ASCII) into ``float32 [n, 3, 3]``.
+
+    The serving upload path: a CAD part arrives as request-body bytes and
+    must never touch the filesystem to be understood. ``load_stl`` is the
+    file wrapper over this. Axis layout ``[triangle, vertex, xyz]``;
+    facet normals are discarded — the voxelizer derives geometry from
+    vertices alone."""
+    if _is_binary_stl(data):
+        return _parse_binary(data)
+    return _parse_ascii(data.decode("utf-8", errors="replace"))
 
 
 def load_stl(path: str) -> np.ndarray:
-    """Load an STL file (binary or ASCII) into a ``float32 [n, 3, 3]`` array.
-
-    Axis layout: ``[triangle, vertex, xyz]``. Facet normals are discarded —
-    the voxelizer derives geometry from vertices alone.
-    """
-    if _is_binary_stl(path):
-        return _load_binary(path)
-    return _load_ascii(path)
-
-
-def _load_binary(path: str) -> np.ndarray:
+    """Load an STL file (binary or ASCII) into a ``float32 [n, 3, 3]`` array
+    (see ``parse_stl`` for the layout)."""
     with open(path, "rb") as f:
-        f.seek(_BINARY_HEADER_BYTES)
-        (count,) = struct.unpack("<I", f.read(4))
-        records = np.fromfile(f, dtype=_RECORD_DTYPE, count=count)
-    if records.shape[0] != count:
-        raise ValueError(
-            f"truncated binary STL: header claims {count} triangles, "
-            f"found {records.shape[0]}"
-        )
+        data = f.read()
+    try:
+        return parse_stl(data)
+    except ValueError as e:
+        raise ValueError(f"{path!r}: {e}") from None
+
+
+def _parse_binary(data: bytes) -> np.ndarray:
+    (count,) = struct.unpack_from("<I", data, _BINARY_HEADER_BYTES)
+    records = np.frombuffer(
+        data, dtype=_RECORD_DTYPE, count=count,
+        offset=_BINARY_HEADER_BYTES + 4,
+    )
     return np.ascontiguousarray(records["verts"], dtype=np.float32)
 
 
-def _load_ascii(path: str) -> np.ndarray:
+def _parse_ascii(text: str) -> np.ndarray:
     verts: list[float] = []
-    with open(path, "r", errors="replace") as f:
-        for line in f:
-            parts = line.split()
-            if len(parts) == 4 and parts[0] == "vertex":
-                verts.extend((float(parts[1]), float(parts[2]), float(parts[3])))
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 4 and parts[0] == "vertex":
+            verts.extend((float(parts[1]), float(parts[2]), float(parts[3])))
     arr = np.asarray(verts, dtype=np.float32)
     if arr.size == 0 or arr.size % 9 != 0:
-        # A binary file whose size doesn't match its record count also lands
-        # here (it fails the binary layout check); name both possibilities.
+        # A binary payload whose size doesn't match its record count also
+        # lands here (it fails the binary layout check); name both.
         raise ValueError(
-            f"malformed STL {path!r}: not a valid binary layout (size/record "
+            "malformed STL: not a valid binary layout (size/record "
             "mismatch — possibly truncated) and not parseable as ASCII"
         )
     return arr.reshape(-1, 3, 3)

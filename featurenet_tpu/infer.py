@@ -104,16 +104,39 @@ class Predictor:
             # Quantize once at construction; the program dequantizes on
             # device, so int8 is what sits in serving HBM.
             self._qparams, self._scales = quantize_tree(self._params)
-            self._program = self.rt.build("serve_int8", batch=batch)
-        else:
-            self._program = self.rt.build("serve", batch=batch)
+        # One executable per compile batch, memoized: the batch-mode API
+        # uses exactly one (``batch``), the serving front end
+        # (featurenet_tpu.serve) warms one per bucket in its ladder.
+        self._programs: dict[int, object] = {}
+        self._program = self.program_for(batch)
+
+    def program_for(self, batch: int):
+        """The ``serve``/``serve_int8`` executable at this compile batch,
+        built AOT through the runtime registry and memoized. Building one
+        per bucket at startup is the serving warmup — afterwards no
+        request shape ever triggers a compile."""
+        batch = int(batch)
+        prog = self._programs.get(batch)
+        if prog is None:
+            name = "serve_int8" if self.precision == "int8" else "serve"
+            prog = self.rt.build(name, batch=batch)
+            self._programs[batch] = prog
+        return prog
+
+    def forward_padded(self, voxels, batch: int | None = None):
+        """Run the compiled forward on an ALREADY padded
+        ``[batch, R, R, R, 1]`` array (no chunking, no trimming); returns
+        the device result. The continuous batcher calls this once per
+        dispatch with its chosen bucket."""
+        prog = self.program_for(
+            batch if batch is not None else voxels.shape[0]
+        )
+        if self.precision == "int8":
+            return prog(self._qparams, self._scales, self._stats, voxels)
+        return prog(self._params, self._stats, voxels)
 
     def _forward(self, voxels):
-        if self.precision == "int8":
-            return self._program(
-                self._qparams, self._scales, self._stats, voxels
-            )
-        return self._program(self._params, self._stats, voxels)
+        return self.forward_padded(voxels, self.batch)
 
     def int8_agreement(self, n: int = 48, seed: int = 0) -> float:
         """Top-1 agreement between the fp32 and int8 forwards on fresh

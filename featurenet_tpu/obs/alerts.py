@@ -56,7 +56,11 @@ WINDOW_METRICS = (
     "data_wait_ms",     # host blocked on the prefetcher, per dispatch group
     "queue_depth",      # prefetch queue depth at each consumer pop
     "heartbeat_age_s",  # inter-beat age at each confirmed progress point
-    "serving_ms",       # per-batch infer latency (the infer_batch span)
+    "serving_ms",       # per-request end-to-end serving latency (the
+                        # infer_batch span, and the continuous batcher's
+                        # enqueue→response interval per request)
+    "queue_wait_ms",    # serving front end: request enqueue→dispatch wait
+                        # (admission pressure building before latency blows)
 )
 
 _WINDOW_STATS = ("p50", "p95", "p99", "max", "mean")
@@ -107,6 +111,15 @@ def known_metrics() -> set[str]:
     for m in WINDOW_METRICS:
         out.update(f"{m}_{s}" for s in _WINDOW_STATS)
     return out
+
+
+def is_serving_metric(metric: str) -> bool:
+    """Whether a rule metric reads off the serving-side windows (request
+    latency / queue wait). The serving front end's drain gate keys off
+    this: an unresolved serving alert at drain time exits nonzero
+    (``cli serve --drain`` / ``cli infer``), while a training-side alert
+    never fails a serving drain."""
+    return metric.startswith(("serving", "queue_wait"))
 
 
 _RULE_RE = re.compile(

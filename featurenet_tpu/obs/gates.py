@@ -77,6 +77,15 @@ DIRECTIONS = {
     "serving_int8_spread_pct": "max",
     "ttfs_cold_s": "max",
     "ttfs_warm_s": "max",
+    # Open-loop serving (serve.loadgen.bench_serving): sustained QPS and
+    # batch occupancy regress DOWNWARD (the service keeping up / the
+    # bucket ladder staying full), end-to-end latency percentiles and
+    # overload rejections regress upward.
+    "serve_qps_sustained": "min",
+    "serve_p50_ms": "max",
+    "serve_p99_ms": "max",
+    "serve_occupancy": "min",
+    "serve_rejected": "max",
 }
 
 
@@ -135,6 +144,11 @@ BENCH_GATE_KEYS = (
     "window_data_wait_p50_ms",
     "window_data_wait_p99_ms",
     "window_queue_depth_p50",
+    "serve_qps_sustained",
+    "serve_p50_ms",
+    "serve_p99_ms",
+    "serve_occupancy",
+    "serve_rejected",
 )
 
 

@@ -55,6 +55,8 @@ KNOWN_SPAN_NAMES = frozenset({
     "checkpoint_save", "checkpoint_restore", "checkpoint_wait",
     # serving (infer.py) and the metrics readback (utils/logging.py)
     "infer_batch",
+    # the continuous batcher's compiled-forward dispatch (serve/batcher.py)
+    "serve_dispatch",
     # offline export / ingest (data/offline.py, data/voxelize.py)
     "build_cache_class", "export_class", "export_seg_shard",
     "seg_cache_flush", "build_seg_cache", "voxelize",
@@ -262,6 +264,17 @@ def _host_summary(events: list[dict]) -> dict:
     n_warn = sum(1 for e in events if e["ev"] == "warning")
     if n_warn:
         out["warnings"] = n_warn
+    # Latest window_summary per metric for THIS host: multi-host serving
+    # skew (one host's p99 blowing while the fleet median looks fine) is
+    # invisible in the merged headline — the host table is where it reads.
+    wins: dict = {}
+    for e in events:
+        if e["ev"] == "window_summary" and e.get("metric"):
+            wins[e["metric"]] = {
+                k: e[k] for k in ("n", "p50", "p99", "seq") if k in e
+            }
+    if wins:
+        out["windows"] = wins
     return out
 
 
@@ -544,6 +557,36 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             "max": round(lat[-1], 3),
         }
 
+    # --- serving front end (continuous batcher) -----------------------------
+    # Every host's stream counts, like the runtime section: a serving
+    # fleet is one service per host and each one's batches/overloads are
+    # part of the answer.
+    sb = [e for e in events if e["ev"] == "serve_batch"]
+    n_over = sum(1 for e in events if e["ev"] == "overload")
+    stops = [e for e in events if e["ev"] == "serve_stop"]
+    if sb or n_over or stops:
+        srows = sum(e.get("n", 0) for e in sb)
+        scap = sum(e.get("bucket", 0) for e in sb)
+        by_bucket: dict[str, int] = {}
+        for e in sb:
+            key = str(e.get("bucket", "?"))
+            by_bucket[key] = by_bucket.get(key, 0) + 1
+        serve: dict = {
+            "batches": len(sb),
+            "rows": srows,
+            "occupancy": round(srows / scap, 4) if scap else None,
+            "by_bucket": dict(sorted(
+                by_bucket.items(),
+                key=lambda kv: (not kv[0].isdigit(),
+                                int(kv[0]) if kv[0].isdigit() else 0),
+            )),
+            "overloads": n_over,
+        }
+        if stops:
+            serve["served"] = stops[-1].get("served")
+            serve["rejected"] = stops[-1].get("rejected")
+        rep["serve"] = serve
+
     # --- warnings / metrics -------------------------------------------------
     # Warnings aggregate across every host (a warning on host 3 must not
     # be invisible in the headline); metrics records would be N-fold
@@ -651,6 +694,18 @@ def format_report(rep: dict) -> str:
                 + (f"{gap:>6.1f}s" if gap is not None else f"{'—':>7}")
                 + f"  {h.get('warnings', 0):>4}"
             )
+        if any(hosts[i].get("windows") for i in hosts):
+            lines.append("  host windows (latest p50/p99):")
+            for i in sorted(hosts):
+                wins = hosts[i].get("windows")
+                if not wins:
+                    continue
+                lines.append(
+                    f"    {i}: " + ", ".join(
+                        f"{m} {wins[m].get('p50')}/{wins[m].get('p99')}"
+                        for m in sorted(wins)
+                    )
+                )
         skew = rep.get("host_skew") or {}
         parts = _skew_parts(skew)
         if parts:
@@ -740,6 +795,23 @@ def format_report(rep: dict) -> str:
             f"row(s); mean {sv['mean']} ms p50 {sv['p50']} ms "
             f"p90 {sv['p90']} ms p99 {sv['p99']} ms max {sv['max']} ms"
         )
+    se = rep.get("serve")
+    if se:
+        occ = se.get("occupancy")
+        lines.append(
+            f"serve: {se['batches']} batch(es), {se['rows']} request(s)"
+            + (f", occupancy {occ * 100:.1f}%" if occ is not None else "")
+            + (f", overloads {se['overloads']}" if se.get("overloads")
+               else "")
+            + (f"; drained served={se['served']} rejected={se['rejected']}"
+               if se.get("served") is not None else "")
+        )
+        if se.get("by_bucket"):
+            lines.append(
+                "  by bucket: " + ", ".join(
+                    f"{k}×{v}" for k, v in se["by_bucket"].items()
+                )
+            )
     w = rep.get("warnings")
     if w:
         lines.append(
@@ -844,7 +916,7 @@ def follow_slo_line(rep: dict) -> Optional[str]:
     parts = []
     windows = slo.get("windows") or {}
     for metric in ("step_ms", "data_wait_ms", "queue_depth",
-                   "heartbeat_age_s", "serving_ms"):
+                   "heartbeat_age_s", "serving_ms", "queue_wait_ms"):
         row = windows.get(metric)
         if row:
             parts.append(
@@ -933,6 +1005,10 @@ KNOWN_EVENT_KINDS = frozenset({
     # hit (deserialized, compile skipped), miss (no entry), reject (entry
     # present but corrupt/stale/probe-refused; degraded to fresh compile).
     "program_compile", "cache_hit", "cache_miss", "cache_reject",
+    # Serving front end (featurenet_tpu.serve): service came up with its
+    # bucket ladder, one dispatched batch (bucket/fill/padding), one
+    # admission fast-reject at the queue bound, and the drain record.
+    "serve_start", "serve_batch", "overload", "serve_stop",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -952,6 +1028,10 @@ REQUIRED_EVENT_FIELDS = {
     "cache_hit": ("program",),
     "cache_miss": ("program",),
     "cache_reject": ("program", "reason"),
+    "serve_start": ("buckets", "max_wait_ms", "queue_limit"),
+    "serve_batch": ("bucket", "n"),
+    "overload": ("queue_depth", "limit"),
+    "serve_stop": ("served", "rejected"),
 }
 
 # Required at EMIT sites (the analysis linter holds new code to the full

@@ -171,6 +171,14 @@ class WindowAggregator:
                 _alerts.fire(rule, value, self._seq, state="resolve")
                 self._alert_active[rule.metric] = False
 
+    def active_alerts(self) -> list[str]:
+        """Rule metrics currently in the fired-but-unresolved state — the
+        serving drain gate reads this after its final ``flush()``."""
+        with self._lock:
+            return sorted(
+                m for m, on in self._alert_active.items() if on
+            )
+
     def rule_value(self, metric: str, now: float) -> Optional[float]:
         """Resolve a rule metric against the current windows: a derived
         metric, or ``<window>_<stat>`` percentile lookup. None when the
@@ -261,3 +269,12 @@ def flush() -> None:
     agg = _agg
     if agg is not None:
         agg.flush()
+
+
+def active_alerts() -> list[str]:
+    """Currently-unresolved alert metrics of the installed aggregator;
+    empty when none is installed (nothing watched = nothing active)."""
+    agg = _agg
+    if agg is None:
+        return []
+    return agg.active_alerts()

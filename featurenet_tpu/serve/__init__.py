@@ -1,0 +1,26 @@
+"""Always-on serving front end: continuous batching over bucketed AOT
+executables, with admission control and SLO-gated latency.
+
+- ``batcher``  — backend-free scheduling core: bounded queue, flush on
+                 max-batch or max-wait, bucket padding, per-request
+                 de-mux, overload fast-reject.
+- ``service``  — the ladder of pre-built ``serve`` executables + the STL
+                 upload path + SLO-gated drain (``InferenceService``).
+- ``http``     — stdlib HTTP front end (``POST /predict`` with STL
+                 bytes, ``GET /stats``).
+- ``loadgen``  — Poisson open-loop load generator; ``bench_serving`` is
+                 bench.py's sustained-QPS / p50/p99 / occupancy row.
+
+Entry point: ``python -m featurenet_tpu.cli serve --checkpoint-dir D``.
+"""
+
+from featurenet_tpu.serve.batcher import (  # noqa: F401
+    ContinuousBatcher,
+    OverloadError,
+    PendingRequest,
+    pick_bucket,
+)
+from featurenet_tpu.serve.service import (  # noqa: F401
+    InferenceService,
+    serve_rules,
+)

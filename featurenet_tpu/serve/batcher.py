@@ -1,0 +1,321 @@
+"""Continuous batcher: the always-on serving front end's scheduling core.
+
+``infer.Predictor`` is a batch-mode API — the caller brings a full array
+and waits. Production traffic is an open-loop stream of single requests,
+and a TPU serves it well only when requests are coalesced into the
+fixed-shape batches the compiled programs were built for. This module is
+that coalescing layer, deliberately backend-free: requests are numpy
+arrays, the model is an injected ``forward(bucket, padded)`` callable,
+and everything — flush policy, bucket selection, padding, de-mux,
+admission control — is testable on a bare CPU with a fake forward.
+
+Scheduling contract:
+
+- **Flush policy**: a batch dispatches when the pending queue reaches the
+  largest bucket (flush-on-max-batch) OR the *oldest* pending request has
+  waited ``max_wait_ms`` (flush-on-max-wait), whichever comes first. A
+  lone request never waits longer than the deadline; a burst never waits
+  at all.
+- **Bucket ladder**: the dispatch batch is padded up to the smallest
+  configured bucket that fits it (``pick_bucket``). Buckets are the only
+  shapes ever dispatched, so a service that pre-built one executable per
+  bucket (``service.InferenceService``) never compiles after warmup.
+- **De-mux**: each request's future receives exactly its own output row;
+  padding rows are dropped on the floor. A forward error resolves every
+  future in that batch with the error — a dead batch must not hang its
+  callers.
+- **Admission control**: the queue is bounded. At the bound, ``submit``
+  fast-rejects with ``OverloadError`` (a structured ``response`` dict for
+  the HTTP layer, an ``overload`` event for the run log) instead of
+  letting latency grow without bound — under overload the operator wants
+  rejections they can count, not a queue they cannot see the end of.
+
+Telemetry (never load-bearing, like the rest of the obs layer): each
+request feeds ``queue_wait_ms`` (enqueue → dispatch) and ``serving_ms``
+(enqueue → response, the end-to-end latency an SLO is written against)
+into the rolling windows; each dispatch emits a ``serve_batch`` event and
+a ``serve_dispatch`` span.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from featurenet_tpu import obs
+from featurenet_tpu.obs import windows as _windows
+
+DEFAULT_BUCKETS = (1, 4, 16, 64)
+DEFAULT_MAX_WAIT_MS = 5.0
+DEFAULT_QUEUE_LIMIT = 64
+
+
+class OverloadError(RuntimeError):
+    """Fast rejection at the admission bound: the queue is full, and the
+    honest answer is an immediate structured "try later" — not an
+    unbounded wait. ``response`` is the wire shape the HTTP front end
+    returns with a 503."""
+
+    def __init__(self, queue_depth: int, limit: int):
+        super().__init__(f"serving queue full ({queue_depth}/{limit})")
+        self.queue_depth = int(queue_depth)
+        self.limit = int(limit)
+
+    @property
+    def response(self) -> dict:
+        return {
+            "error": "overload",
+            "queue_depth": self.queue_depth,
+            "limit": self.limit,
+        }
+
+
+def normalize_buckets(buckets: Sequence[int]) -> tuple[int, ...]:
+    """The one bucket-ladder validation (sorted, deduped, all >= 1) —
+    shared by the batcher, the service, and the CLI so the ladder rules
+    can never drift between surfaces."""
+    bs = tuple(sorted({int(b) for b in buckets}))
+    if not bs or bs[0] < 1:
+        raise ValueError(
+            f"buckets must be positive batch sizes, got {buckets!r}"
+        )
+    return bs
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket that fits ``n`` rows (callers cap ``n`` at the
+    largest bucket, which is also the fallback)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+class PendingRequest:
+    """One enqueued request: a future the batcher resolves with this
+    request's own output row (or the batch's forward error)."""
+
+    __slots__ = ("voxels", "t_enq", "t_done", "value", "error", "_event")
+
+    def __init__(self, voxels: np.ndarray):
+        self.voxels = voxels
+        self.t_enq = time.perf_counter()
+        self.t_done: Optional[float] = None
+        self.value = None
+        self.error: Optional[BaseException] = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"serving request not answered within {timeout}s"
+            )
+        if self.error is not None:
+            raise RuntimeError(
+                f"serving forward failed: {self.error}"
+            ) from self.error
+        return self.value
+
+    @property
+    def latency_ms(self) -> Optional[float]:
+        """End-to-end latency (enqueue → response), once resolved."""
+        if self.t_done is None:
+            return None
+        return (self.t_done - self.t_enq) * 1e3
+
+
+class ContinuousBatcher:
+    """Bounded request queue + dispatcher thread implementing the flush /
+    bucket / de-mux / admission contract in the module doc.
+
+    ``forward(bucket, padded)`` receives a ``[bucket, ...]`` array whose
+    first ``n <= bucket`` rows are real requests and must return an
+    indexable ``[bucket, ...]`` result (row i answers request i). The
+    service layer binds this to one pre-built executable per bucket.
+    """
+
+    def __init__(self, forward: Callable, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
+                 queue_limit: int = DEFAULT_QUEUE_LIMIT):
+        bs = normalize_buckets(buckets)
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        if queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.forward = forward
+        self.buckets = bs
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.queue_limit = int(queue_limit)
+        self._cv = threading.Condition()
+        self._queue: deque[PendingRequest] = deque()
+        self._draining = False
+        self._stopped = False
+        self._served = 0
+        self._rejected = 0
+        self._errors = 0
+        self._batches = 0
+        self._rows = 0
+        self._capacity = 0
+        self._by_bucket: dict[int, int] = {}
+        self._worker = threading.Thread(
+            target=self._run, name="serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- producer side -------------------------------------------------------
+    def submit(self, voxels: np.ndarray) -> PendingRequest:
+        """Enqueue one request; returns its future. Raises
+        ``OverloadError`` immediately at the queue bound and
+        ``RuntimeError`` after ``drain()``."""
+        p = PendingRequest(voxels)
+        with self._cv:
+            if self._draining:
+                raise RuntimeError(
+                    "batcher is draining; no new requests accepted"
+                )
+            depth = len(self._queue)
+            if depth >= self.queue_limit:
+                self._rejected += 1
+            else:
+                self._queue.append(p)
+                self._cv.notify_all()
+                depth = -1
+        if depth >= 0:
+            # Emit outside the lock: the sink has its own, and a slow
+            # filesystem must not extend the admission critical section.
+            obs.emit("overload", queue_depth=depth, limit=self.queue_limit)
+            raise OverloadError(depth, self.queue_limit)
+        return p
+
+    # -- dispatcher thread ---------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._dispatch(batch)
+
+    def _next_batch(self) -> Optional[list[PendingRequest]]:
+        """Block until the flush policy says dispatch; None = drained."""
+        max_b = self.buckets[-1]
+        with self._cv:
+            while not self._queue:
+                if self._draining:
+                    return None
+                self._cv.wait()
+            # Flush when the largest bucket fills OR the oldest request's
+            # wait hits the deadline — whichever first. Draining flushes
+            # immediately: a shutdown must not pad out its own deadline.
+            while len(self._queue) < max_b and not self._draining:
+                now = time.perf_counter()
+                deadline = self._queue[0].t_enq + self.max_wait_s
+                if now >= deadline:
+                    break
+                self._cv.wait(timeout=deadline - now)
+            k = min(len(self._queue), max_b)
+            # Deadline flushes can catch an awkward count (say 17 on a
+            # 1/4/16/64 ladder): padding it to the smallest fitting
+            # bucket would run under half full. When a smaller bucket
+            # can be dispatched FULL and the fitting bucket would be
+            # less than half occupied, take the full bucket and leave
+            # the remainder queued — its deadline has already passed,
+            # so it flushes immediately on the next loop under the same
+            # rule. Every dispatch is then >= 50% occupied whenever a
+            # full smaller bucket existed.
+            fit = pick_bucket(k, self.buckets)
+            if fit > k and 2 * k < fit:
+                full = [b for b in self.buckets if b <= k]
+                if full:
+                    k = full[-1]
+            return [self._queue.popleft() for _ in range(k)]
+
+    def _dispatch(self, batch: list[PendingRequest]) -> None:
+        n = len(batch)
+        bucket = pick_bucket(n, self.buckets)
+        t_disp = time.perf_counter()
+        for p in batch:
+            _windows.observe("queue_wait_ms", (t_disp - p.t_enq) * 1e3)
+        arr = np.stack([p.voxels for p in batch])
+        if bucket > n:
+            arr = np.concatenate(
+                [arr, np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)]
+            )
+        out = None
+        err: Optional[BaseException] = None
+        try:
+            with obs.span("serve_dispatch", bucket=bucket, n=n):
+                out = self.forward(bucket, arr)
+        except Exception as e:  # resolve the batch; the batcher survives
+            err = e
+        t_done = time.perf_counter()
+        for i, p in enumerate(batch):
+            if err is not None:
+                p.error = err
+            else:
+                p.value = out[i]
+            p.t_done = t_done
+            p._event.set()
+            # End-to-end latency = queue wait + dispatch + device +
+            # readback: the number an SLO is written against.
+            _windows.observe("serving_ms", (t_done - p.t_enq) * 1e3)
+        with self._cv:
+            self._batches += 1
+            self._rows += n
+            self._capacity += bucket
+            self._by_bucket[bucket] = self._by_bucket.get(bucket, 0) + 1
+            if err is None:
+                self._served += n
+            else:
+                self._errors += n
+        obs.emit("serve_batch", bucket=bucket, n=n, pad=bucket - n,
+                 dur_ms=round((t_done - t_disp) * 1e3, 3))
+
+    # -- lifecycle / introspection -------------------------------------------
+    def stats(self) -> dict:
+        with self._cv:
+            cap = self._capacity
+            return {
+                "served": self._served,
+                "rejected": self._rejected,
+                "errors": self._errors,
+                "batches": self._batches,
+                # Mean batch occupancy: real rows / padded capacity — the
+                # padding tax of the bucket ladder at this traffic shape.
+                "occupancy": round(self._rows / cap, 4) if cap else None,
+                "by_bucket": dict(sorted(self._by_bucket.items())),
+                "queue_depth": len(self._queue),
+            }
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Stop accepting, flush everything already admitted, stop the
+        dispatcher, and return final stats. Every accepted request is
+        answered before the thread exits — unless the join times out
+        (a wedged forward), which the stats must not paper over:
+        ``drain_timeout`` flips true, a warning lands in the run log,
+        and the service turns it into a nonzero exit. Idempotent."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        self._worker.join(timeout=timeout_s)
+        st = self.stats()
+        st["drain_timeout"] = self._worker.is_alive()
+        if st["drain_timeout"]:
+            obs.warn(
+                "serve_drain_timeout",
+                f"dispatcher still running {timeout_s}s after drain; "
+                f"{st['queue_depth']} request(s) may go unanswered",
+            )
+        with self._cv:
+            first = not self._stopped
+            self._stopped = True
+        if first:
+            obs.emit("serve_stop", served=st["served"],
+                     rejected=st["rejected"])
+        return st

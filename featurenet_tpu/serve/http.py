@@ -1,0 +1,100 @@
+"""Stdlib HTTP front end for the inference service.
+
+One endpoint that matters: ``POST /predict`` with raw STL bytes as the
+body returns the prediction as JSON — the end-to-end upload path (bytes →
+parse → voxelize → continuous batcher → compiled forward → response).
+Status codes carry the admission contract:
+
+- ``200`` — answered; body is ``InferenceService.format_row`` output.
+- ``400`` — unparseable STL; the body names the parse failure.
+- ``503`` — overload fast-reject; body is ``OverloadError.response``
+  (``{"error": "overload", "queue_depth": ..., "limit": ...}``) so a
+  load balancer can back off on structure, not on string-matching.
+- ``504`` — admitted but not answered within the handler timeout.
+
+``GET /stats`` (alias ``/healthz``) returns the batcher counters —
+served/rejected/occupancy/queue depth — for external monitoring.
+
+Threading model: ``ThreadingHTTPServer`` with daemon threads; each
+request thread does its own STL parse + voxelization (host-side geometry
+must never block the dispatch thread) and then parks on its future. The
+batcher coalesces across request threads — concurrency IS the batch
+shape.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from featurenet_tpu.serve.batcher import OverloadError
+
+DEFAULT_REQUEST_TIMEOUT_S = 60.0
+
+
+def make_server(service, host: str = "127.0.0.1", port: int = 0,
+                request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S
+                ) -> ThreadingHTTPServer:
+    """Build (but do not start) the HTTP server; ``port=0`` binds an
+    ephemeral port (read it back from ``server_address``). Run with
+    ``serve_forever()`` — typically on a daemon thread — and stop with
+    ``shutdown()`` before draining the service."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
+            pass  # access logging is the obs layer's job, not stderr's
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib name)
+            if self.path in ("/stats", "/healthz"):
+                self._json(200, {"ok": True, **service.stats()})
+                return
+            self._json(404, {"error": "not_found",
+                             "endpoints": ["POST /predict", "GET /stats"]})
+
+        def do_POST(self):  # noqa: N802 (stdlib name)
+            if self.path != "/predict":
+                self._json(404, {"error": "not_found",
+                                 "endpoints": ["POST /predict",
+                                               "GET /stats"]})
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            data = self.rfile.read(length)
+            try:
+                fut = service.submit_stl_bytes(data)
+            except OverloadError as e:
+                self._json(503, e.response)
+                return
+            except ValueError as e:
+                self._json(400, {"error": "bad_stl", "detail": str(e)})
+                return
+            except RuntimeError as e:
+                # A handler thread that slipped in between shutdown()
+                # and drain() gets the batcher's "draining" refusal —
+                # answer it structurally like any other rejection, not
+                # with a dropped socket. (OverloadError is a
+                # RuntimeError; its clause above must come first.)
+                self._json(503, {"error": "draining", "detail": str(e)})
+                return
+            try:
+                row = fut.result(timeout=request_timeout_s)
+            except TimeoutError:
+                self._json(504, {"error": "timeout",
+                                 "timeout_s": request_timeout_s})
+                return
+            except RuntimeError as e:
+                self._json(500, {"error": "forward_failed",
+                                 "detail": str(e)})
+                return
+            self._json(200, service.format_row(row))
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.daemon_threads = True
+    return srv

@@ -1,0 +1,139 @@
+"""Poisson open-loop load generator for the serving front end.
+
+Closed-loop measurement (``benchmark.measure_inference``) asks "how fast
+can the device go when a full batch is always waiting" — bench r04's ~48k
+inferences/sec/chip is that number, and no real traffic pattern can reach
+it. This module asks the production question: at an *open-loop* arrival
+rate — requests arrive on a Poisson clock whether or not the service has
+finished the previous ones — what QPS does the service sustain, what do
+the end-to-end p50/p99 look like, and how full do the dispatch buckets
+run?
+
+Open-loop discipline: arrivals are scheduled from the exponential
+inter-arrival draws up front, and the generator sleeps only when it is
+*ahead* of schedule — a slow service makes the generator submit late but
+never slower, which is exactly how a load balancer treats a slow backend.
+Rejections (``OverloadError``) are counted, not retried: retry storms are
+a client policy, not a generator's.
+
+``bench_serving`` is the bench.py entry point: a random-init weights
+service (throughput is weight-agnostic) measured at a target fraction of
+the closed-loop rate, returning the flat ``serve_*`` fields bench pins in
+``gate_summary``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from featurenet_tpu.obs.report import _pct
+from featurenet_tpu.serve.batcher import OverloadError
+
+# Bench loadgen sizing: offered load as a fraction of the measured
+# closed-loop serving rate (deep enough to fill the big buckets, far
+# enough from saturation that p99 measures the service, not the queue),
+# and a cap so a Python-thread generator is never asked for arrival gaps
+# it cannot schedule.
+BENCH_LOAD_FRACTION = 0.3
+BENCH_QPS_CAP = 8000.0
+
+
+def poisson_load(service, qps: float, n_requests: int,
+                 rng: Optional[np.random.Generator] = None,
+                 grids: Optional[np.ndarray] = None,
+                 timeout_s: float = 120.0) -> tuple[dict, list]:
+    """Drive ``service`` with ``n_requests`` Poisson arrivals at rate
+    ``qps``; returns ``(stats, futures)`` where ``futures`` are the
+    accepted requests' resolved futures (request i's grid is
+    ``grids[i % len(grids)]`` — callers verify answers against a
+    reference forward). Every accepted request is waited on before the
+    stats are computed, so ``sustained_qps`` is answered-requests over
+    the full wall, not an admission rate."""
+    if qps <= 0:
+        raise ValueError(f"qps must be > 0, got {qps}")
+    if rng is None:
+        rng = np.random.default_rng(0)
+    if grids is None:
+        from featurenet_tpu.data.synthetic import generate_batch
+
+        grids = generate_batch(
+            rng, min(64, max(1, n_requests)), service.cfg.resolution
+        )["voxels"]
+    arrivals = np.cumsum(rng.exponential(1.0 / qps, size=n_requests))
+    t0 = time.perf_counter()
+    futures: list = []
+    rejected = 0
+    for i in range(n_requests):
+        ahead = arrivals[i] - (time.perf_counter() - t0)
+        if ahead > 0:
+            time.sleep(ahead)
+        try:
+            futures.append(service.submit_voxels(grids[i % len(grids)]))
+        except OverloadError:
+            rejected += 1
+    for fut in futures:
+        fut.result(timeout=timeout_s)
+    wall = time.perf_counter() - t0
+    lats = sorted(f.latency_ms for f in futures)
+    st = service.stats()
+    stats = {
+        "offered_qps": round(n_requests / float(arrivals[-1]), 1),
+        "sustained_qps": round(len(futures) / wall, 1) if wall > 0 else None,
+        "accepted": len(futures),
+        "rejected": rejected,
+        "p50_ms": round(_pct(lats, 50), 3) if lats else None,
+        "p99_ms": round(_pct(lats, 99), 3) if lats else None,
+        "occupancy": st["occupancy"],
+        "by_bucket": st["by_bucket"],
+    }
+    return stats, futures
+
+
+def bench_serving(cfg, qps: float, n_requests: int = 512,
+                  buckets: Sequence[int] = (1, 4, 16, 64),
+                  max_wait_ms: float = 5.0,
+                  queue_limit: int = 256) -> dict:
+    """The bench.py serving row: build a random-init service for ``cfg``
+    (throughput is weight-agnostic, like ``measure_inference``), run the
+    open-loop generator at ``qps``, drain, and return flat ``serve_*``
+    fields for the gate summary."""
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.registry import build_model
+    from featurenet_tpu.serve.service import InferenceService
+
+    R = cfg.resolution
+    variables = build_model(cfg).init(
+        jax.random.key(0), jnp.zeros((1, R, R, R, 1), jnp.float32),
+        train=False,
+    )
+    pred = Predictor(
+        variables["params"], variables["batch_stats"], cfg,
+        batch=max(buckets),
+    )
+    service = InferenceService(
+        pred, buckets=buckets, max_wait_ms=max_wait_ms,
+        queue_limit=queue_limit,
+    )
+    try:
+        stats, _ = poisson_load(
+            service, qps=qps, n_requests=n_requests,
+            rng=np.random.default_rng(0),
+        )
+    finally:
+        service.drain()
+    return {
+        "serve_qps_offered": stats["offered_qps"],
+        "serve_qps_sustained": stats["sustained_qps"],
+        "serve_p50_ms": stats["p50_ms"],
+        "serve_p99_ms": stats["p99_ms"],
+        "serve_occupancy": stats["occupancy"],
+        "serve_rejected": stats["rejected"],
+        "serve_buckets": {str(k): v for k, v in stats["by_bucket"].items()},
+        "serve_requests": n_requests,
+    }

@@ -1,0 +1,197 @@
+"""The always-on inference service: bucketed AOT executables behind the
+continuous batcher, with SLO-gated drain.
+
+``InferenceService`` glues the three serving layers this package exists
+to combine:
+
+- **A warm bucket ladder**: one ``serve``/``serve_int8`` executable per
+  configured bucket, built through the runtime registry at construction
+  (``Predictor.program_for``) — with ``Config.exec_cache_dir`` set they
+  deserialize from the persistent cache. After construction, no request
+  ever pays an XLA compile; the load-gen e2e asserts this via
+  ``program_compile`` events.
+- **The continuous batcher** (``serve.batcher``): flush on max-batch or
+  max-wait, pad to the smallest fitting bucket, de-mux per request,
+  fast-reject under overload.
+- **The upload path**: ``submit_stl_bytes`` takes raw STL bytes (a CAD
+  part as it arrives over the wire), parses (``data.stl.parse_stl``) and
+  voxelizes (``data.voxelize``) it host-side in the caller's thread, and
+  enqueues the grid — so the service accepts real parts, not
+  pre-voxelized tensors, and the (comparatively slow) geometry work never
+  blocks the dispatch thread.
+
+SLO gating: the service installs alert rules over the serving windows
+(``serving_p99_ms`` end-to-end latency, ``queue_wait_ms_p99`` queue wait
+— ``serve_rules``; a custom ``Config.alert_rules`` spec replaces them).
+``drain()`` flushes the final window cycle and reports which serving
+alerts are still unresolved; its ``exit_code`` (0 clean, 2 on an active
+serving alert) is what ``cli serve --drain`` and ``cli infer`` exit with,
+so CI can gate on latency regressions the same way it gates on accuracy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from featurenet_tpu import obs
+from featurenet_tpu.obs import alerts as _alerts
+from featurenet_tpu.obs import windows as _windows
+from featurenet_tpu.serve.batcher import (
+    DEFAULT_BUCKETS,
+    DEFAULT_MAX_WAIT_MS,
+    DEFAULT_QUEUE_LIMIT,
+    ContinuousBatcher,
+    PendingRequest,
+    normalize_buckets,
+)
+
+# Default p99 end-to-end SLO for the built-in serving rules. Generous by
+# design: the operator's real SLO arrives via --slo-p99-ms or a full
+# --alert-rules spec; the default exists so an unconfigured service still
+# notices a pathological tail.
+DEFAULT_SLO_P99_MS = 250.0
+
+
+def serve_rules(slo_p99_ms: float = DEFAULT_SLO_P99_MS) -> list:
+    """The serving alert-rule set: the built-in defaults plus the two
+    rules no batch workload has — end-to-end p99 latency against the SLO
+    and queue-wait p99 (admission pressure building before latency
+    blows)."""
+    return list(_alerts.DEFAULT_RULES) + [
+        _alerts.AlertRule("serving_p99_ms", ">", float(slo_p99_ms),
+                          "critical"),
+        _alerts.AlertRule("queue_wait_ms_p99", ">", float(slo_p99_ms),
+                          "warning"),
+    ]
+
+
+class InferenceService:
+    """Continuous-batching serving over a ``Predictor``'s checkpoint.
+
+    Construction is the warmup: every bucket's executable builds (or
+    loads from the exec cache) before the batcher accepts a request.
+    ``rules=None`` installs ``serve_rules(slo_p99_ms)`` over the rolling
+    windows; pass an explicit rule list (e.g. from a ``--alert-rules``
+    spec) to take full control, or ``rules=()`` to leave whatever
+    aggregator is already installed untouched.
+    """
+
+    def __init__(self, predictor, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
+                 queue_limit: int = DEFAULT_QUEUE_LIMIT,
+                 rules: Optional[Sequence] = None,
+                 slo_p99_ms: float = DEFAULT_SLO_P99_MS,
+                 emit_every_s: float = _windows.DEFAULT_EMIT_EVERY_S):
+        self.predictor = predictor
+        self.cfg = predictor.cfg
+        self.buckets = normalize_buckets(buckets)
+        # AOT warmup: one serve build per bucket through the runtime
+        # registry (memoized in Predictor._programs, which _forward
+        # re-resolves per dispatch). This loop is the whole reason no
+        # request ever sees a compile — every shape the batcher can
+        # dispatch exists now.
+        for b in self.buckets:
+            predictor.program_for(b)
+        if rules is None:
+            rules = serve_rules(slo_p99_ms)
+        if rules:
+            _windows.install(_windows.WindowAggregator(
+                rules=list(rules), emit_every_s=emit_every_s
+            ))
+        self.batcher = ContinuousBatcher(
+            self._forward, buckets=self.buckets, max_wait_ms=max_wait_ms,
+            queue_limit=queue_limit,
+        )
+        obs.emit("serve_start", buckets=list(self.buckets),
+                 max_wait_ms=float(max_wait_ms), queue_limit=int(queue_limit))
+
+    # -- the dispatch hot path ----------------------------------------------
+    def _forward(self, bucket: int, padded: np.ndarray):
+        # lint: allow-host-sync(the readback IS the served response)
+        return np.asarray(self.predictor.forward_padded(padded, batch=bucket))
+
+    # -- request entry points ------------------------------------------------
+    def submit_voxels(self, grid: np.ndarray) -> PendingRequest:
+        """Enqueue one ``[R,R,R]`` (or ``[R,R,R,1]``) occupancy grid;
+        returns its future. ``OverloadError`` at the admission bound."""
+        # lint: allow-host-sync(host-side request payload, never on device)
+        g = np.asarray(grid, dtype=np.float32)
+        if g.ndim == 3:
+            g = g[..., None]
+        R = self.cfg.resolution
+        if g.shape != (R, R, R, 1):
+            raise ValueError(
+                f"expected one [{R},{R},{R}(,1)] grid, got {g.shape}"
+            )
+        return self.batcher.submit(g)
+
+    def submit_stl_bytes(self, data: bytes,
+                         fill: bool = True) -> PendingRequest:
+        """The upload path: raw STL bytes → parse → normalize+voxelize →
+        enqueue. Geometry runs in the caller's thread (an HTTP worker),
+        never the dispatch thread; malformed bytes raise ``ValueError``
+        before anything is admitted."""
+        from featurenet_tpu.data.stl import parse_stl
+        from featurenet_tpu.data.voxelize import voxelize
+
+        tris = parse_stl(data)
+        grid = voxelize(tris, self.cfg.resolution, fill=fill)
+        return self.submit_voxels(grid.astype(np.float32))
+
+    def format_row(self, row: np.ndarray) -> dict:
+        """One request's output row as the wire response: class + top-3
+        for classify checkpoints, per-class feature-voxel counts for
+        segment ones."""
+        from featurenet_tpu.data.synthetic import CLASS_NAMES
+
+        if self.cfg.task == "segment":
+            counts = np.bincount(
+                # lint: allow-host-sync(row is a host array post-readback)
+                np.asarray(row, np.int32).ravel(),
+                minlength=len(CLASS_NAMES) + 1,
+            )
+            return {
+                "voxel_counts": {
+                    (CLASS_NAMES[c - 1] if c - 1 < len(CLASS_NAMES)
+                     else f"class_{c - 1}"): int(counts[c])
+                    for c in range(1, len(counts))
+                    if counts[c]
+                },
+            }
+        # lint: allow-host-sync(row is already a host array — see above)
+        probs = np.asarray(row, np.float32)
+        label = int(probs.argmax())
+        order = np.argsort(probs)[::-1][:3]
+        return {
+            "label": label,
+            "class_name": CLASS_NAMES[label],
+            "prob": float(probs[label]),
+            "top3": [(CLASS_NAMES[int(i)], float(probs[i])) for i in order],
+        }
+
+    def predict(self, fut: PendingRequest,
+                timeout: Optional[float] = None) -> dict:
+        return self.format_row(fut.result(timeout))
+
+    # -- lifecycle -----------------------------------------------------------
+    def stats(self) -> dict:
+        return self.batcher.stats()
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Stop accepting, answer everything admitted, flush the final
+        window cycle, and report the SLO verdict: ``exit_code`` is 2 when
+        a serving alert (``alerts.is_serving_metric``) is still
+        unresolved at drain time — the CI latency gate — or when the
+        batcher's drain timed out with admitted requests unanswered;
+        else 0."""
+        st = self.batcher.drain(timeout_s)
+        _windows.flush()
+        active = [
+            m for m in _windows.active_alerts()
+            if _alerts.is_serving_metric(m)
+        ]
+        st["active_serving_alerts"] = active
+        st["exit_code"] = 2 if (active or st["drain_timeout"]) else 0
+        return st

@@ -39,10 +39,32 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+from featurenet_tpu import faults, obs  # noqa: E402
+
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# --- process-wide state hygiene ----------------------------------------------
+# The obs sink, the window aggregator, and the fault plan are deliberately
+# process-wide singletons; a test that leaks one poisons every later test
+# in the worker (a dark-sink test suddenly writing into a dead tmpdir, a
+# fault plan firing in an unrelated e2e). One shared autouse reset here
+# replaces the per-file teardown fixtures PR 5/6 accumulated — both sides
+# of the yield, so a leaky PREVIOUS file can't contaminate the first test
+# of the next one either. obs.close_run() also drops the aggregator
+# (windows.uninstall) and flushes nothing when no sink is active, so the
+# reset is a no-op for the already-clean majority.
+
+@pytest.fixture(autouse=True)
+def _reset_process_state():
+    obs.close_run()
+    faults.uninstall()
+    yield
+    obs.close_run()
+    faults.uninstall()
 
 
 # --- slow tier ---------------------------------------------------------------

@@ -19,12 +19,8 @@ import pytest
 from featurenet_tpu import faults, obs
 
 
-@pytest.fixture(autouse=True)
-def _no_leaked_plan():
-    """Every test starts and ends with no process-wide fault plan."""
-    faults.uninstall()
-    yield
-    faults.uninstall()
+# Process-wide obs/faults state is reset by conftest's autouse
+# _reset_process_state fixture (tests-tree fixture hygiene, PR 7).
 
 
 # --- registry ----------------------------------------------------------------

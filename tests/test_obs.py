@@ -22,14 +22,8 @@ from featurenet_tpu.obs.spans import chrome_trace
 from featurenet_tpu.train import Trainer
 
 
-@pytest.fixture(autouse=True)
-def _isolated_sink():
-    """Obs state is process-wide; no test may leak an active sink into the
-    rest of the suite (every other test file runs without a run_dir and
-    must stay on the zero-overhead null path)."""
-    obs.close_run()
-    yield
-    obs.close_run()
+# Process-wide obs/faults state is reset by conftest's autouse
+# _reset_process_state fixture (tests-tree fixture hygiene, PR 7).
 
 
 def test_events_schema_roundtrip(tmp_path):

@@ -30,12 +30,8 @@ from featurenet_tpu.train.loop import Trainer
 from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE, supervise
 
 
-@pytest.fixture(autouse=True)
-def _no_leaked_plan():
-    faults.uninstall()
-    yield
-    faults.uninstall()
-    obs.close_run()
+# Process-wide obs/faults state is reset by conftest's autouse
+# _reset_process_state fixture (tests-tree fixture hygiene, PR 7).
 
 
 @pytest.fixture

@@ -1,0 +1,781 @@
+"""Serving front end (featurenet_tpu.serve): continuous batcher scheduling
+(flush policy, bucket padding, de-mux, admission control), the
+InferenceService over real bucketed AOT executables, the STL upload path,
+the HTTP front end, the Poisson open-loop load generator, SLO-gated drain
+exit codes (serve + infer), and the bench probe/gate plumbing.
+
+The acceptance spine (ISSUE 7): an open-loop load-gen e2e on CPU where
+every accepted request gets exactly one response with the right label,
+zero XLA compiles happen after warmup (``program_compile`` events), ≥2
+bucket sizes fill; an overload burst produces structured rejections; a
+serving alert fires and resolves as a hysteresis pair; and an unresolved
+serving alert at drain time yields a nonzero exit code.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from featurenet_tpu import obs
+from featurenet_tpu.config import get_config
+from featurenet_tpu.obs import alerts, windows
+from featurenet_tpu.obs.report import build_report, format_report, load_events
+from featurenet_tpu.serve.batcher import (
+    ContinuousBatcher,
+    OverloadError,
+    pick_bucket,
+)
+from featurenet_tpu.serve.loadgen import poisson_load
+from featurenet_tpu.serve.service import InferenceService, serve_rules
+
+RES = 16  # smoke16 resolution — every real-model test runs at 16³
+
+
+def _grid(value: float = 1.0) -> np.ndarray:
+    return np.full((RES, RES, RES, 1), value, np.float32)
+
+
+def _sum_forward(calls=None):
+    """Fake forward: row i's answer is row i's sum — any de-mux mixup is
+    immediately visible as a wrong value."""
+
+    def forward(bucket, arr):
+        if calls is not None:
+            calls.append((bucket, arr.shape[0]))
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    return forward
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    """Random-init smoke16 Predictor (weights don't matter for scheduling
+    and throughput semantics; label agreement is checked against the same
+    predictor's batch API)."""
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.registry import build_model
+
+    cfg = get_config("smoke16", data_workers=1)
+    variables = build_model(cfg).init(
+        jax.random.key(0), jnp.zeros((1, RES, RES, RES, 1), jnp.float32),
+        train=False,
+    )
+    return Predictor(
+        variables["params"], variables["batch_stats"], cfg, batch=4
+    )
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    """A real trained smoke16 checkpoint for the CLI-level tests."""
+    from featurenet_tpu.train import Trainer
+
+    d = str(tmp_path_factory.mktemp("serve_ckpt") / "ckpt")
+    cfg = get_config(
+        "smoke16", total_steps=6, eval_every=10**9, checkpoint_every=6,
+        log_every=6, checkpoint_dir=d, data_workers=1,
+    )
+    Trainer(cfg).run()
+    return d
+
+
+@pytest.fixture()
+def stl_bytes(tmp_path):
+    from featurenet_tpu.data.mesh_primitives import mesh_box
+    from featurenet_tpu.data.stl import save_stl
+
+    p = str(tmp_path / "part.stl")
+    save_stl(p, mesh_box((0.2, 0.2, 0.2), (0.8, 0.8, 0.7)))
+    with open(p, "rb") as fh:
+        return fh.read()
+
+
+# --- batcher: scheduling core (backend-free) ---------------------------------
+
+def test_pick_bucket_ladder():
+    assert pick_bucket(1, (1, 4, 16)) == 1
+    assert pick_bucket(2, (1, 4, 16)) == 4
+    assert pick_bucket(4, (1, 4, 16)) == 4
+    assert pick_bucket(5, (1, 4, 16)) == 16
+    assert pick_bucket(99, (1, 4, 16)) == 16  # callers cap at the max
+    with pytest.raises(ValueError, match="buckets"):
+        ContinuousBatcher(_sum_forward(), buckets=())
+    with pytest.raises(ValueError, match="queue_limit"):
+        ContinuousBatcher(_sum_forward(), queue_limit=0)
+
+
+def test_flush_on_max_batch_beats_the_deadline():
+    """A burst that fills the largest bucket dispatches immediately — it
+    must NOT sit out the (deliberately huge) max-wait deadline."""
+    calls: list = []
+    b = ContinuousBatcher(
+        _sum_forward(calls), buckets=(1, 4), max_wait_ms=60_000,
+        queue_limit=16,
+    )
+    t0 = time.perf_counter()
+    futs = [b.submit(np.full((2,), float(i))) for i in range(4)]
+    vals = [f.result(10) for f in futs]
+    assert time.perf_counter() - t0 < 30  # seconds, not the 60s deadline
+    assert vals == [0.0, 2.0, 4.0, 6.0]
+    assert (4, 4) in calls  # one full bucket-4 dispatch
+    b.drain()
+
+
+def test_flush_on_max_wait_for_partial_batch():
+    """A partial batch dispatches at the oldest request's deadline,
+    padded to the smallest fitting bucket."""
+    calls: list = []
+    b = ContinuousBatcher(
+        _sum_forward(calls), buckets=(1, 4, 16), max_wait_ms=50,
+        queue_limit=16,
+    )
+    futs = [b.submit(np.full((2,), float(i))) for i in range(2)]
+    vals = [f.result(10) for f in futs]
+    assert vals == [0.0, 2.0]
+    assert calls[0] == (4, 4)  # 2 rows dispatched padded to bucket 4
+    # The wait is the flush deadline, not forever: well under a second
+    # for a 50 ms deadline even on a loaded box.
+    assert all(f.latency_ms < 10_000 for f in futs)
+    st = b.drain()
+    assert st["occupancy"] == 0.5
+    assert st["by_bucket"] == {4: 1}
+
+
+def test_demux_ordering_under_interleaved_arrivals():
+    """Concurrent submitters each get exactly their own answer back —
+    row-sum forward makes any cross-wiring a value mismatch."""
+    b = ContinuousBatcher(
+        _sum_forward(), buckets=(1, 4, 16), max_wait_ms=2, queue_limit=128,
+    )
+    results: dict[int, float] = {}
+    lock = threading.Lock()
+
+    def client(base: int):
+        for j in range(10):
+            v = float(base * 100 + j)
+            got = b.submit(np.full((3,), v)).result(30)
+            with lock:
+                results[int(v)] = got
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert len(results) == 30
+    for v, got in results.items():
+        assert got == pytest.approx(3.0 * v)
+    st = b.drain()
+    assert st["served"] == 30 and st["errors"] == 0
+
+
+def test_fast_reject_at_queue_bound(tmp_path):
+    """At the admission bound, submit() rejects immediately with the
+    structured overload response (and an ``overload`` event) instead of
+    queueing — and the already-admitted requests still get answers."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    gate = threading.Event()
+
+    def blocked(bucket, arr):
+        gate.wait(30)
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b = ContinuousBatcher(blocked, buckets=(1, 2), max_wait_ms=1,
+                          queue_limit=3)
+    futs = [b.submit(np.ones((1,))) for _ in range(2)]  # first dispatch
+    time.sleep(0.2)  # let the dispatcher pick them up and block
+    futs += [b.submit(np.ones((1,))) for _ in range(3)]  # fill the queue
+    t0 = time.perf_counter()
+    with pytest.raises(OverloadError) as ei:
+        b.submit(np.ones((1,)))
+    assert time.perf_counter() - t0 < 5  # fast-reject, no deadline wait
+    assert ei.value.response == {
+        "error": "overload", "queue_depth": 3, "limit": 3,
+    }
+    gate.set()
+    for f in futs:
+        f.result(30)
+    st = b.drain()
+    assert st["rejected"] == 1 and st["served"] == 5
+    obs.close_run()
+    events, _ = load_events(run_dir)
+    over = [e for e in events if e["ev"] == "overload"]
+    assert len(over) == 1
+    assert over[0]["queue_depth"] == 3 and over[0]["limit"] == 3
+    # drain is recorded with the final counters
+    stop = [e for e in events if e["ev"] == "serve_stop"]
+    assert stop and stop[-1]["served"] == 5 and stop[-1]["rejected"] == 1
+
+
+def test_forward_error_resolves_batch_and_batcher_survives():
+    flaky = {"fail": True}
+
+    def forward(bucket, arr):
+        if flaky["fail"]:
+            flaky["fail"] = False
+            raise ValueError("injected forward failure")
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b = ContinuousBatcher(forward, buckets=(1, 2), max_wait_ms=1,
+                          queue_limit=8)
+    with pytest.raises(RuntimeError, match="injected forward failure"):
+        b.submit(np.ones((2,))).result(10)
+    # The dead batch resolved; the next one serves normally.
+    assert b.submit(np.full((2,), 3.0)).result(10) == pytest.approx(6.0)
+    st = b.drain()
+    assert st["errors"] == 1 and st["served"] == 1
+
+
+def test_drain_refuses_new_requests():
+    b = ContinuousBatcher(_sum_forward(), buckets=(1,), max_wait_ms=1)
+    b.drain()
+    with pytest.raises(RuntimeError, match="draining"):
+        b.submit(np.ones((1,)))
+
+
+def test_deadline_flush_prefers_full_bucket_over_heavy_padding():
+    """An awkward deadline-flush count (5 on a 1/4/16 ladder) must not
+    pad to the under-half-full fitting bucket (16, 11 zeros): dispatch
+    the full bucket-4 and let the leftover — its deadline already past —
+    flush immediately as bucket-1. Every row served, zero padding."""
+    calls: list = []
+    gate = threading.Event()
+
+    def gated(bucket, arr):
+        gate.wait(30)  # hold the dispatcher so 5 requests accumulate
+        calls.append((bucket, arr.shape[0]))
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b = ContinuousBatcher(gated, buckets=(1, 4, 16), max_wait_ms=20,
+                          queue_limit=32)
+    futs = [b.submit(np.full((1,), float(i))) for i in range(1)]
+    time.sleep(0.1)  # dispatcher picks up the first request and blocks
+    futs += [b.submit(np.full((1,), float(i))) for i in range(1, 6)]
+    time.sleep(0.1)  # all 5 are queued and past the flush deadline
+    gate.set()
+    assert [f.result(10) for f in futs] == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+    st = b.drain()
+    # first lone request → bucket 1, then the 5-deep backlog → 4 + 1
+    assert calls == [(1, 1), (4, 4), (1, 1)]
+    assert st["occupancy"] == 1.0  # no padding anywhere
+
+
+def test_drain_timeout_reported_and_gates_exit_code(predictor):
+    """A wedged forward must not let drain() claim a clean shutdown: the
+    join timeout flips ``drain_timeout`` and the service's SLO verdict
+    exits nonzero even with every alert quiet."""
+    service = InferenceService(
+        predictor, buckets=(1,), max_wait_ms=1, queue_limit=4, rules=(),
+    )
+    gate = threading.Event()
+    real_forward = service.batcher.forward
+
+    def wedged(bucket, arr):
+        gate.wait(30)
+        return real_forward(bucket, arr)
+
+    service.batcher.forward = wedged
+    fut = service.submit_voxels(np.zeros((RES, RES, RES), np.float32))
+    st = service.drain(timeout_s=0.3)
+    assert st["drain_timeout"] is True
+    assert st["active_serving_alerts"] == []
+    assert st["exit_code"] == 2  # unanswered admitted work = not clean
+    gate.set()  # unwedge; the dispatcher answers and exits
+    fut.result(30)
+    service.batcher._worker.join(10)
+    assert service.batcher.drain()["drain_timeout"] is False
+
+
+# --- windows/alerts: the queue_wait metric and the serving predicate ---------
+
+def test_queue_wait_window_and_serving_metric_predicate():
+    assert "queue_wait_ms_p99" in alerts.known_metrics()
+    agg = windows.WindowAggregator()
+    agg.observe("queue_wait_ms", 5.0)
+    assert agg.rule_value(
+        "queue_wait_ms_p99", time.perf_counter()
+    ) == pytest.approx(5.0)
+    assert alerts.is_serving_metric("serving_p99_ms")
+    assert alerts.is_serving_metric("serving_ms_p50")
+    assert alerts.is_serving_metric("queue_wait_ms_p99")
+    assert not alerts.is_serving_metric("data_wait_fraction")
+    assert not alerts.is_serving_metric("queue_depth")
+    # serve_rules: the defaults plus the two serving rules, SLO threaded.
+    rules = serve_rules(slo_p99_ms=42.0)
+    by_metric = {r.metric: r for r in rules}
+    assert by_metric["serving_p99_ms"].threshold == 42.0
+    assert by_metric["serving_p99_ms"].severity == "critical"
+    assert by_metric["queue_wait_ms_p99"].threshold == 42.0
+    assert "data_wait_fraction" in by_metric  # defaults still present
+
+
+# --- the service: warm ladder + open-loop load-gen e2e (acceptance) ----------
+
+def test_service_loadgen_e2e_zero_compiles_correct_labels(tmp_path, rng):
+    """The acceptance spine: Poisson arrivals + a max-bucket burst through
+    a freshly warmed service. Every accepted request gets exactly one
+    response whose label matches the batch-mode reference; ≥2 bucket
+    sizes fill; and not one ``program_compile`` event lands after
+    warmup."""
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.registry import build_model
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    cfg = get_config("smoke16", data_workers=1)
+    variables = build_model(cfg).init(
+        jax.random.key(1), jnp.zeros((1, RES, RES, RES, 1), jnp.float32),
+        train=False,
+    )
+    pred = Predictor(
+        variables["params"], variables["batch_stats"], cfg, batch=4
+    )
+    service = InferenceService(
+        pred, buckets=(1, 4, 16), max_wait_ms=25, queue_limit=64,
+        rules=(),  # keep init_run's ambient aggregator
+    )
+    events, _ = load_events(run_dir)
+    compiles_at_warmup = sum(
+        1 for e in events if e["ev"] == "program_compile"
+    )
+    assert compiles_at_warmup >= 3  # one serve build per bucket (4 shared)
+
+    grids = generate_batch(rng, 24, RES)["voxels"]
+    expected, _ = pred.predict_voxels(grids)  # batch-mode reference
+
+    stats, futs = poisson_load(
+        service, qps=150.0, n_requests=24,
+        rng=np.random.default_rng(7), grids=grids,
+    )
+    assert stats["rejected"] == 0 and stats["accepted"] == 24
+    assert len(futs) == 24
+    for i, fut in enumerate(futs):
+        probs = fut.result(30)
+        assert int(np.argmax(probs)) == int(expected[i % len(grids)])
+        assert fut.latency_ms is not None and fut.latency_ms > 0
+    # Deterministic bucket-fill: a 17-burst flushes a full 16-bucket
+    # immediately and leaves one request for a smaller bucket.
+    burst = [service.submit_voxels(grids[i % 24]) for i in range(17)]
+    for i, fut in enumerate(burst):
+        assert int(np.argmax(fut.result(30))) == int(expected[i % 24])
+    st = service.drain()
+    assert st["exit_code"] == 0 and st["active_serving_alerts"] == []
+    assert len(st["by_bucket"]) >= 2, st  # ≥2 bucket sizes filled
+    assert st["served"] == 24 + 17
+    assert 0 < st["occupancy"] <= 1.0
+
+    obs.close_run()
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    compiles_total = sum(
+        1 for e in events if e["ev"] == "program_compile"
+    )
+    assert compiles_total == compiles_at_warmup  # ZERO compiles post-warmup
+    # The report folds the serving telemetry: serve section with bucket
+    # histogram + occupancy, serve_start/stop, window summaries.
+    rep = build_report(events)
+    assert rep["serve"]["batches"] == sum(st["by_bucket"].values())
+    assert rep["serve"]["rows"] == st["served"]
+    assert rep["serve"]["occupancy"] == pytest.approx(st["occupancy"])
+    assert len(rep["serve"]["by_bucket"]) >= 2
+    text = format_report(rep)
+    assert "serve:" in text and "by bucket:" in text
+    wins = (rep.get("slo") or {}).get("windows") or {}
+    assert "serving_ms" in wins and "queue_wait_ms" in wins
+
+
+def test_service_slo_alert_fire_resolve_and_drain_exit_codes(
+    tmp_path, predictor
+):
+    """A slow forward blows the p99 SLO → ONE alert fires; recovery
+    resolves it (hysteresis pair); drain after recovery exits 0. A
+    service drained mid-violation exits 2 with the alert named. The
+    overload burst rides the slow phase: structured rejections while the
+    queue is pinned."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    service = InferenceService(
+        predictor, buckets=(1, 2), max_wait_ms=1, queue_limit=4,
+        rules=serve_rules(slo_p99_ms=100.0), emit_every_s=0.0,
+    )
+    real_forward = service.batcher.forward
+    slow = {"sleep_s": 0.3}
+
+    def throttled(bucket, arr):
+        time.sleep(slow["sleep_s"])
+        return real_forward(bucket, arr)
+
+    service.batcher.forward = throttled
+    # Overload burst while the forward is slow: the queue (limit 4) pins
+    # and later arrivals fast-reject with the structured response.
+    futs, rejections = [], []
+    for i in range(12):
+        try:
+            futs.append(service.submit_voxels(_grid(float(i))))
+        except OverloadError as e:
+            rejections.append(e.response)
+    for f in futs:
+        f.result(60)
+    assert rejections, "the burst must overflow the bounded queue"
+    assert all(r["error"] == "overload" and r["limit"] == 4
+               for r in rejections)
+    windows.flush()
+    assert "serving_p99_ms" in windows.active_alerts()
+    # Recovery: fast forward, enough samples to evict the slow tail from
+    # the 128-deep serving window → the paired resolve fires.
+    slow["sleep_s"] = 0.0
+    for i in range(140):
+        service.submit_voxels(_grid(0.0)).result(30)
+    windows.flush()
+    assert "serving_p99_ms" not in windows.active_alerts()
+    st = service.drain()
+    assert st["exit_code"] == 0 and st["active_serving_alerts"] == []
+    obs.close_run()
+
+    events, _ = load_events(run_dir)
+    fires = [e for e in events if e["ev"] == "alert"
+             and e["rule"] == "serving_p99_ms"]
+    assert [e["state"] for e in fires] == ["fire", "resolve"]
+    assert len([e for e in events if e["ev"] == "overload"]) \
+        == len(rejections)
+
+    # Second service, drained while still in violation → exit code 2.
+    obs.init_run(str(tmp_path / "run2"), process_index=0)
+    service2 = InferenceService(
+        predictor, buckets=(1, 2), max_wait_ms=1, queue_limit=8,
+        rules=serve_rules(slo_p99_ms=100.0), emit_every_s=0.0,
+    )
+    fwd2 = service2.batcher.forward
+    service2.batcher.forward = \
+        lambda bucket, arr: (time.sleep(0.3), fwd2(bucket, arr))[1]
+    for _ in range(3):
+        service2.submit_voxels(_grid()).result(30)
+    st2 = service2.drain()
+    assert st2["exit_code"] == 2
+    assert "serving_p99_ms" in st2["active_serving_alerts"]
+    obs.close_run()
+
+
+# --- the upload path: STL bytes → voxelize → predict -------------------------
+
+def test_parse_stl_bytes_matches_file_loader(tmp_path, stl_bytes):
+    from featurenet_tpu.data.mesh_primitives import mesh_box
+    from featurenet_tpu.data.stl import load_stl, parse_stl, save_stl
+
+    p = str(tmp_path / "ref.stl")
+    save_stl(p, mesh_box((0.2, 0.2, 0.2), (0.8, 0.8, 0.7)))
+    np.testing.assert_array_equal(parse_stl(stl_bytes), load_stl(p))
+    # ASCII bytes parse too (the upload path cannot assume an exporter).
+    tris = parse_stl(stl_bytes)
+    ascii_text = "solid x\n" + "".join(
+        "facet normal 0 0 0\nouter loop\n"
+        + "".join(f"vertex {v[0]} {v[1]} {v[2]}\n" for v in tri)
+        + "endloop\nendfacet\n"
+        for tri in tris
+    ) + "endsolid x\n"
+    np.testing.assert_allclose(
+        parse_stl(ascii_text.encode()), tris, rtol=1e-6
+    )
+    with pytest.raises(ValueError, match="malformed STL"):
+        parse_stl(b"this is not an STL at all")
+    with pytest.raises(ValueError, match="malformed STL"):
+        parse_stl(stl_bytes[:-7])  # truncated binary record
+
+
+def test_service_stl_upload_end_to_end(tmp_path, predictor, stl_bytes):
+    from featurenet_tpu.data.mesh_primitives import mesh_box
+    from featurenet_tpu.data.stl import save_stl
+
+    service = InferenceService(
+        predictor, buckets=(1, 4), max_wait_ms=2, queue_limit=8, rules=(),
+    )
+    row = service.predict(service.submit_stl_bytes(stl_bytes), timeout=60)
+    assert set(row) == {"label", "class_name", "prob", "top3"}
+    assert 0.0 <= row["prob"] <= 1.0 and len(row["top3"]) == 3
+    # Same part through the batch-mode STL path → same label.
+    p = str(tmp_path / "same.stl")
+    save_stl(p, mesh_box((0.2, 0.2, 0.2), (0.8, 0.8, 0.7)))
+    (ref,) = predictor.predict_stl([p])
+    assert row["label"] == ref.label and row["class_name"] == ref.class_name
+    with pytest.raises(ValueError):
+        service.submit_stl_bytes(b"garbage bytes")
+    with pytest.raises(ValueError, match="expected one"):
+        service.submit_voxels(np.zeros((4, 4, 4), np.float32))
+    service.drain()
+
+
+# --- HTTP front end ----------------------------------------------------------
+
+def test_http_predict_stats_and_error_codes(predictor, stl_bytes):
+    import http.client
+
+    from featurenet_tpu.serve.http import make_server
+
+    service = InferenceService(
+        predictor, buckets=(1, 4), max_wait_ms=2, queue_limit=8, rules=(),
+    )
+    srv = make_server(service, port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        def request(method, path, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            payload = json.loads(resp.read().decode())
+            conn.close()
+            return resp.status, payload
+
+        status, row = request("POST", "/predict", stl_bytes)
+        assert status == 200
+        assert "class_name" in row and len(row["top3"]) == 3
+        status, err = request("POST", "/predict", b"not an stl")
+        assert status == 400 and err["error"] == "bad_stl"
+        status, st = request("GET", "/stats")
+        assert status == 200 and st["ok"] and st["served"] >= 1
+        status, err = request("GET", "/nope")
+        assert status == 404 and err["error"] == "not_found"
+    finally:
+        srv.shutdown()
+        service.drain()
+
+
+# --- CLI: serve + infer exit-code gating -------------------------------------
+
+def test_cli_serve_http_roundtrip_and_drain(ckpt_dir, stl_bytes, tmp_path):
+    """`cli serve` end to end: boot, answer a real STL upload over HTTP,
+    drain at --duration-s, exit clean (no SLO violation at a sane
+    threshold)."""
+    import http.client
+    import socket
+
+    from featurenet_tpu.cli import main as cli_main
+
+    # Reserve an ephemeral port for the server (the CLI prints its bound
+    # port on stdout, which a same-process test can't read in time).
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    result: dict = {}
+
+    def client():
+        deadline = time.perf_counter() + 120
+        while time.perf_counter() < deadline:
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=30
+                )
+                conn.request("POST", "/predict", body=stl_bytes)
+                resp = conn.getresponse()
+                result["status"] = resp.status
+                result["row"] = json.loads(resp.read().decode())
+                conn.close()
+                return
+            except OSError:
+                time.sleep(0.1)
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    cli_main([
+        "serve", "--checkpoint-dir", ckpt_dir, "--buckets", "1,2",
+        "--max-wait-ms", "2", "--port", str(port), "--duration-s", "6",
+        "--drain", "--run-dir", str(tmp_path / "run"),
+    ])
+    t.join(30)
+    assert result.get("status") == 200, result
+    assert "class_name" in result["row"]
+    events, _ = load_events(str(tmp_path / "run"))
+    kinds = {e["ev"] for e in events}
+    assert {"serve_start", "serve_batch", "serve_stop"} <= kinds
+
+
+def test_cli_infer_exit_code_gated_by_serving_alert(
+    ckpt_dir, tmp_path, stl_bytes
+):
+    """The carried-over SLO follow-on: serving alert rules drive infer's
+    exit code — an unresolved serving_ms alert at drain time exits 2, a
+    healthy run exits clean."""
+    from featurenet_tpu.cli import main as cli_main
+
+    stl = str(tmp_path / "part.stl")
+    with open(stl, "wb") as fh:
+        fh.write(stl_bytes)
+    # Impossible threshold → the alert fires and cannot resolve → exit 2.
+    with pytest.raises(SystemExit) as ei:
+        cli_main([
+            "infer", stl, "--checkpoint-dir", ckpt_dir,
+            "--run-dir", str(tmp_path / "bad"),
+            "--alert-rules", "serving_p99_ms>0.0001:critical",
+        ])
+    assert ei.value.code == 2
+    # Generous threshold → same run shape exits clean (returns None).
+    assert cli_main([
+        "infer", stl, "--checkpoint-dir", ckpt_dir,
+        "--run-dir", str(tmp_path / "ok"),
+        "--alert-rules", "serving_p99_ms>1e9",
+    ]) is None
+    # --alert-rules without --run-dir is a refusal, not a silent no-gate.
+    with pytest.raises(SystemExit, match="alert-rules"):
+        cli_main([
+            "infer", stl, "--checkpoint-dir", ckpt_dir,
+            "--alert-rules", "serving_p99_ms>1e9",
+        ])
+
+
+# --- report: per-host window summaries (carried-over follow-on) --------------
+
+def test_report_per_host_window_summaries():
+    t0 = 1000.0
+    events = []
+    for host in (0, 1):
+        events.append({"t": t0, "ev": "run_start", "process_index": host})
+        events.append({
+            "t": t0 + 1, "ev": "window_summary", "metric": "serving_ms",
+            "n": 50, "p50": 5.0 + host * 20, "p95": 8.0,
+            "p99": 9.0 + host * 40, "mean": 5.5, "max": 10.0, "seq": 1,
+            "process_index": host,
+        })
+    rep = build_report(events)
+    assert rep["hosts"][0]["windows"]["serving_ms"]["p50"] == 5.0
+    assert rep["hosts"][1]["windows"]["serving_ms"]["p50"] == 25.0
+    assert rep["hosts"][1]["windows"]["serving_ms"]["p99"] == 49.0
+    text = format_report(rep)
+    assert "host windows (latest p50/p99):" in text
+    assert "serving_ms 25.0/49.0" in text
+
+
+# --- bench: serve gate keys + probe robustness (BENCH_r05 satellite) ---------
+
+def test_bench_gate_serve_keys_and_directions():
+    from featurenet_tpu.obs import gates
+
+    summary = {
+        "value": 16000.0,
+        "serve_qps_sustained": 900.0,
+        "serve_p50_ms": 4.2,
+        "serve_p99_ms": 11.0,
+        "serve_occupancy": 0.71,
+        "serve_rejected": 0.0,
+    }
+    vals = gates.bench_gate_values(summary)
+    for k in summary:
+        assert k in vals, k
+    pin = gates.make_baseline(vals)["gates"]
+    assert pin["serve_qps_sustained"]["direction"] == "min"
+    assert pin["serve_p99_ms"]["direction"] == "max"
+    assert pin["serve_occupancy"]["direction"] == "min"
+    assert pin["serve_rejected"]["direction"] == "max"
+    # A QPS collapse or a p99 blowup is a regression; the reverse passes.
+    worse = dict(vals, serve_qps_sustained=450.0, serve_p99_ms=33.0)
+    res = gates.evaluate_gates(worse, {"gates": pin})
+    assert not res["ok"]
+    assert {"serve_qps_sustained", "serve_p99_ms"} <= set(res["failed"])
+    better = dict(vals, serve_qps_sustained=1200.0, serve_p99_ms=6.0)
+    assert gates.evaluate_gates(better, {"gates": pin})["ok"]
+
+
+R05_TRACEBACK_TAIL = (
+    "Traceback (most recent call last):\n"
+    '  File "jaxlib/xla_client.py", line 161, in make_c_api_client\n'
+    "    return _xla.get_c_api_client(\n"
+    "jax.errors.JaxRuntimeError: UNAVAILABLE: TPU backend setup/compile "
+    "error (Unavailable).\n"
+    "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+    "backend setup/compile error (Unavailable).\n"
+)
+
+
+class _FakeProc:
+    def __init__(self, returncode, stdout="", stderr=""):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def _bench_record(capsys):
+    lines = [
+        ln for ln in capsys.readouterr().out.strip().splitlines() if ln
+    ]
+    return json.loads(lines[-1])
+
+
+def test_bench_probe_skip_record_on_plugin_init_failure(monkeypatch, capsys):
+    """The BENCH_r05 shape: the probe child dies rc=1 with a raw
+    make_c_api_client traceback. bench.main() must end in ONE structured
+    skipped record, never an unhandled traceback."""
+    import subprocess
+
+    import bench
+
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: _FakeProc(1, stderr=R05_TRACEBACK_TAIL),
+    )
+    bench.main()  # must not raise
+    rec = _bench_record(capsys)
+    assert rec["skipped"] is True
+    assert rec["reason"] == "tpu_backend_unavailable"
+    assert rec["backend"] == "cpu_fallback"
+    assert "UNAVAILABLE" in rec["error"]
+
+
+def test_bench_probe_child_reports_its_own_init_error(monkeypatch, capsys):
+    """The hardened child catches make_c_api_client raising during plugin
+    init and answers in JSON (rc 0) — the parent turns it into the same
+    structured skip."""
+    import subprocess
+
+    import bench
+
+    child_line = json.dumps({
+        "probe_error": "JaxRuntimeError: UNAVAILABLE: TPU backend "
+                       "setup/compile error (Unavailable).",
+    })
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: _FakeProc(0, stdout="plugin noise\n" + child_line),
+    )
+    bench.main()
+    rec = _bench_record(capsys)
+    assert rec["skipped"] is True
+    assert rec["reason"] == "tpu_backend_unavailable"
+    assert "UNAVAILABLE" in rec["error"]
+
+
+def test_bench_probe_parses_platform_through_noise(monkeypatch, capsys):
+    """A healthy CPU-only box: the platform JSON line is found even under
+    plugin chatter, and the round records the no-accelerator skip."""
+    import subprocess
+
+    import bench
+
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **k: _FakeProc(
+            0, stdout="W warning spam\n" + json.dumps({"platform": "cpu"})
+        ),
+    )
+    bench.main()
+    rec = _bench_record(capsys)
+    assert rec["skipped"] is True
+    assert rec["reason"] == "no_accelerator_platform"
+    assert rec["error"] is None

@@ -30,14 +30,8 @@ from featurenet_tpu.obs.report import (
 from featurenet_tpu.train.loop import Trainer
 
 
-@pytest.fixture(autouse=True)
-def _isolated_state():
-    """Obs + faults state is process-wide; never leak across tests."""
-    obs.close_run()
-    faults.uninstall()
-    yield
-    obs.close_run()
-    faults.uninstall()
+# Process-wide obs/faults state is reset by conftest's autouse
+# _reset_process_state fixture (tests-tree fixture hygiene, PR 7).
 
 
 # --- rolling windows ---------------------------------------------------------
