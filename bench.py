@@ -85,6 +85,29 @@ def _window_gate_fields(run_dir: str) -> dict:
     return out
 
 
+# Signatures of the backend DYING UNDER the measurement (the BENCH_r05
+# outage shape: the probe passed, then jax.devices() raised inside
+# measure_train_step when the lease lapsed mid-round). Matched against
+# the formatted traceback so the artifact can say "the backend was lost"
+# instead of the generic "something raised" — the two reasons route to
+# different operators (infra vs bench code).
+_BACKEND_LOSS_SIGNATURES = (
+    "Unable to initialize backend",
+    "UNAVAILABLE",
+    "TPU backend setup/compile error",
+    "JaxRuntimeError",
+    "DEADLINE_EXCEEDED",
+    "failed to connect",
+    "Socket closed",
+)
+
+
+def _is_backend_loss(error_text: str) -> bool:
+    """True when a mid-measurement exception reads as the accelerator
+    (or its tunnel) going away, rather than a bug in the measurement."""
+    return any(sig in error_text for sig in _BACKEND_LOSS_SIGNATURES)
+
+
 # The probe child's whole job is to die informatively: it catches its OWN
 # backend-init failure (make_c_api_client raising JaxRuntimeError during
 # plugin init — the BENCH_r05 outage shape) and reports it as one JSON
@@ -200,17 +223,22 @@ def main() -> None:
         out = _measure_round(platform)
     except Exception:
         # The probe can't rule out a MID-measurement outage (r05's actual
-        # failure shape: the backend died between rows). The artifact must
-        # still be one parseable line carrying the evidence.
+        # failure shape: the backend died between rows — jax.devices()
+        # raising inside measure_train_step AFTER the probe passed). The
+        # artifact must still be one parseable line carrying the
+        # evidence, with the outage named as an outage ("backend_lost")
+        # rather than the generic measurement error.
         import traceback
 
+        tb = traceback.format_exc()
         print(json.dumps({
             "metric": "featurenet64_train_throughput",
             "bench_schema": 2,
             "skipped": True,
-            "reason": "measurement_error",
+            "reason": ("backend_lost" if _is_backend_loss(tb)
+                       else "measurement_error"),
             "backend": platform,
-            "error": traceback.format_exc()[-1500:],
+            "error": tb[-1500:],
             "load_avg_1m": float(os.getloadavg()[0]),
         }))
         return
@@ -224,7 +252,9 @@ def _measure_round(platform: str) -> dict:
     from featurenet_tpu.benchmark import (
         V100_SAMPLES_PER_SEC_EST,
         measure_e2e,
+        measure_host_spread,
         measure_inference,
+        measure_scaling,
         measure_train_step,
         measure_ttfs,
     )
@@ -287,6 +317,29 @@ def _measure_round(platform: str) -> dict:
                 * serving["inferences_per_sec_per_chip"]),
         n_requests=512,
     )
+    # Scaling-efficiency gate rows (the MULTICHIP_r0*.json series made
+    # self-policing): per-chip train throughput at every power-of-two
+    # mesh shape this session's devices allow, plus the cross-host
+    # data-wait spread of a 2-process CPU probe run. Either half failing
+    # degrades to absent gate keys with the error in-artifact — the
+    # main headline numbers are already paid for.
+    scaling_rows: dict = {}
+    try:
+        sc = measure_scaling(cfg, repeats=2)
+        for n, row in sc["shapes"].items():
+            scaling_rows[f"scaling_sps_per_chip_{n}x"] = (
+                row["samples_per_sec_per_chip"]
+            )
+        if "scaling_efficiency" in sc:
+            scaling_rows["scaling_efficiency"] = sc["scaling_efficiency"]
+    except Exception as e:
+        scaling_rows["scaling_error"] = repr(e)[:500]
+    try:
+        scaling_rows["data_wait_spread"] = (
+            measure_host_spread()["data_wait_spread"]
+        )
+    except Exception as e:
+        scaling_rows["spread_probe_error"] = repr(e)[:500]
     e2e = {}
     if os.path.isdir(E2E_CACHE):
         import tempfile
@@ -417,6 +470,7 @@ def _measure_round(platform: str) -> dict:
         # QPS, end-to-end p50/p99 at the target load, mean batch
         # occupancy of the bucket ladder, overload rejections.
         **serve_row,
+        **scaling_rows,
         **e2e,
     }
     # Self-policing (obs.gates): every round carries a pin-ready
@@ -458,6 +512,10 @@ def _measure_round(platform: str) -> dict:
         ("serve_p50_ms", 5.0),
         ("serve_p99_ms", 15.0),
         ("serve_rejected", 16.0),
+        # Near-zero by design on a healthy mesh (hosts fed evenly);
+        # relative tolerance on ~0 would pin "never change" — the gate
+        # is for a host falling behind by whole percentage points.
+        ("data_wait_spread", 0.1),
     ):
         pin = out["gate_summary"]["gates"].get(noisy)
         if pin is not None:

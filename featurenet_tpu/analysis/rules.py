@@ -208,7 +208,14 @@ def fault_sites_rule(tree: Tree) -> list[Finding]:
 # forward — a stray sync there is paid once per live batch.
 HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py",
                     "data/dataset.py", "serve/batcher.py",
-                    "serve/service.py")
+                    "serve/service.py",
+                    # The elastic layer is backend-free BY CONTRACT: the
+                    # coordinator process supervises N training children
+                    # and must never initialize (or sync against) a
+                    # device — a host sync creeping in here would wedge
+                    # the one process whose job is to outlive the mesh.
+                    "elastic/coordinator.py", "elastic/membership.py",
+                    "elastic/planner.py")
 
 
 def _is_host_sync(node: ast.Call) -> Optional[str]:
@@ -393,6 +400,16 @@ FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "stall_timeout": (),
     "max_restarts": (),
     "supervised_child": (),  # internal respawn marker
+    # Elastic coordinator policy (featurenet_tpu.elastic): the world
+    # roster and its device footprint belong to the coordinator process,
+    # not to the per-child run config (Config.elastic/min_world_size ARE
+    # fields and map 1:1).
+    "world_size": (),
+    "local_devices": (),
+    "elastic_rank": (),       # internal: child's rank in the generation
+    "elastic_world": (),      # internal: generation world size
+    "elastic_port": (),       # internal: jax.distributed coordinator port
+    "elastic_generation": (),  # internal: membership generation counter
     "no_augment": ("augment",),
     "no_spatial": ("spatial",),
     "no_augment_affine_rotate": ("augment_affine_rotate",),

@@ -85,11 +85,19 @@ def _converged_slope(
 def measure_train_step(
     cfg, batch_per_chip: int = BATCH, warmup: int = WARMUP,
     measure: int = MEASURE, repeats: int = 1,
+    devices=None, min_window_sec: float = 3.0,
 ) -> dict:
     """Slope-time the compiled train step for ``cfg`` on all devices.
 
     Returns per-chip throughput plus the analytic-MFU fields. Weak scaling:
     the per-chip batch stays fixed regardless of chip count.
+
+    ``devices``: restrict the mesh to these devices (default: all) — the
+    scaling sweep (``measure_scaling``) measures the same program over
+    device subsets so the per-chip retention vs chip count is one
+    session's apples-to-apples. ``min_window_sec``: the converged-slope
+    window floor (tests shrink it; the 3 s default is the honest one on
+    the tunneled backend).
 
     ``repeats``: minimum independent slope draws. The measurement runs the
     shared ``_converged_slope`` protocol (≥3 s windows, draw until the two
@@ -118,16 +126,18 @@ def measure_train_step(
         mfu,
         train_step_flops_per_sample,
     )
+    from featurenet_tpu.parallel.mesh import make_mesh
     from featurenet_tpu.runtime import Runtime
 
-    n_chips = len(jax.devices())
+    devices = list(devices) if devices is not None else jax.devices()
+    n_chips = len(devices)
     # The measured program is the registry's own train_step at the swept
     # batch — what the Trainer dispatches is by construction what the
     # bench (and ops/bench_arch's variant sweep) times.
     rt = Runtime(dataclasses.replace(
-        cfg, global_batch=batch_per_chip * len(jax.devices()),
+        cfg, global_batch=batch_per_chip * n_chips,
         steps_per_dispatch=1, mesh_model=1, spatial=False,
-    ))
+    ), mesh=make_mesh(data=n_chips, model=1, devices=devices))
     mesh = rt.mesh
     global_batch = rt.cfg.global_batch
     R = cfg.resolution
@@ -153,7 +163,8 @@ def measure_train_step(
         float(metrics["loss"])  # device→host readback = honest sync
         return time.perf_counter() - t0
 
-    conv = _converged_slope(walled, measure, repeats)
+    conv = _converged_slope(walled, measure, repeats,
+                            min_window_sec=min_window_sec)
     per_step = conv["per_call"]
     sps_chip = global_batch / per_step / n_chips
     fps = train_step_flops_per_sample(cfg.arch, R)
@@ -437,3 +448,163 @@ def measure_inference(
         "spread_pct": conv["spread_pct"],
         "spread_minmax_pct": conv["spread_minmax_pct"],
     }
+
+
+def measure_scaling(
+    cfg, batch_per_chip: int = BATCH, repeats: int = 2,
+    shapes=None, min_window_sec: float = 3.0,
+) -> dict:
+    """Per-chip train-step throughput at each power-of-two data-mesh
+    shape this session's devices allow — the scaling-efficiency half of
+    the MULTICHIP gate (the series used to be raw stdout tails a human
+    eyeballed round over round; these rows pin samples/sec *vs chip
+    count* so a widening lockstep tax fails a gate instead of hiding in
+    a log).
+
+    Weak scaling (per-chip batch fixed), the exact ``measure_train_step``
+    protocol per shape, all shapes in one session so the rows are
+    comparable. Returns ``{"shapes": {n: row}, "scaling_efficiency": r}``
+    — ``r`` is the largest shape's per-chip rate over the single-chip
+    rate (1.0 = perfect retention; absent with only one device).
+    """
+    import jax
+
+    n_dev = len(jax.devices())
+    if shapes is None:
+        shapes = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= n_dev]
+    rows: dict = {}
+    for n in shapes:
+        if n > n_dev:
+            raise ValueError(f"shape {n} exceeds {n_dev} device(s)")
+        rows[n] = measure_train_step(
+            cfg, batch_per_chip=batch_per_chip, repeats=repeats,
+            devices=jax.devices()[:n], min_window_sec=min_window_sec,
+        )
+    out: dict = {"shapes": rows}
+    if len(rows) > 1:
+        lo, hi = min(rows), max(rows)
+        out["scaling_efficiency"] = round(
+            rows[hi]["samples_per_sec_per_chip"]
+            / max(rows[lo]["samples_per_sec_per_chip"], 1e-9), 4
+        )
+    return out
+
+
+# The spread probe's worker: a tiny 2-process CPU mesh running a few real
+# train steps with a run_dir, so the merged report's cross-host data-wait
+# spread — the number the MULTICHIP series never pinned — exists for the
+# gate even on a single-chip driver. CPU on purpose: the probe measures
+# the HOST feed skew machinery end to end, and must not touch (or depend
+# on) the accelerator the main measurements own.
+_SPREAD_WORKER = r"""
+import json, os, sys
+rank, nproc, port, run_dir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nproc, process_id=rank,
+)
+from featurenet_tpu.config import get_config
+from featurenet_tpu.train.loop import Trainer
+cfg = get_config(
+    "smoke16", total_steps=2, global_batch=8, data_workers=1,
+    eval_batches=1, log_every=10**9, eval_every=10**9,
+    checkpoint_every=10**9, run_dir=run_dir,
+)
+Trainer(cfg).run()
+print("SPREAD_OK")
+"""
+
+
+def measure_host_spread(n_hosts: int = 2, timeout_s: float = 600.0) -> dict:
+    """Cross-host data-wait spread of a real ``n_hosts``-process run —
+    ``data_wait_spread`` for the scaling gate. Spawns the probe workers,
+    merges their per-host event streams, and extracts the report's gate
+    scalars. Raises on any probe failure; the caller (bench) degrades to
+    an absent gate key with the error in-artifact."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    import featurenet_tpu
+    from featurenet_tpu.obs.gates import report_gate_values
+    from featurenet_tpu.obs.report import build_report_dir
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    run_dir = tempfile.mkdtemp(prefix="fn_spread_")
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(
+            os.path.dirname(os.path.abspath(featurenet_tpu.__file__))
+        ),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _SPREAD_WORKER, str(i), str(n_hosts),
+             str(port), run_dir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(n_hosts)
+    ]
+    outs = [""] * n_hosts
+
+    def drain(i: int, p) -> None:
+        outs[i] = p.communicate()[0]
+
+    threads = [
+        threading.Thread(target=drain, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ]
+    import shutil
+
+    try:
+        try:
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + timeout_s
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for t in threads:
+                t.join(timeout=30)
+        if any(p.returncode != 0 for p in procs) \
+                or not all("SPREAD_OK" in o for o in outs):
+            raise RuntimeError(
+                "spread probe worker failed: "
+                + " | ".join(o[-400:] for o in outs)
+            )
+        vals = report_gate_values(build_report_dir(run_dir))
+    finally:
+        # Failure paths leak the per-probe tempdir otherwise — bench
+        # runs this every round, and a flaky gloo init would pile run
+        # dirs in /tmp (the slo-tempdir lesson from the PR 5 review).
+        shutil.rmtree(run_dir, ignore_errors=True)
+    if "data_wait_spread" not in vals:
+        raise RuntimeError(
+            "spread probe produced no data_wait_spread (hosts missing "
+            "loop telemetry)"
+        )
+    return {"data_wait_spread": vals["data_wait_spread"],
+            "n_hosts": n_hosts}

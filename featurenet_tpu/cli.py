@@ -164,6 +164,34 @@ def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
     # flag through (the child's respawner is the supervisor itself).
     p.add_argument("--supervised-child", action="store_true",
                    help=argparse.SUPPRESS)
+    p.add_argument("--elastic", action="store_true",
+                   help="run under the elastic coordinator "
+                        "(featurenet_tpu.elastic): spawn --world-size "
+                        "training processes, re-form the mesh at the "
+                        "surviving count on host loss (resume from the "
+                        "latest checkpoint, global batch preserved), and "
+                        "re-admit recovered hosts at the next generation "
+                        "boundary; requires --checkpoint-dir and "
+                        "--run-dir (membership file + heartbeats)")
+    p.add_argument("--world-size", type=int, default=1,
+                   help="(--elastic) host slots at full strength; each "
+                        "slot is one training process of the "
+                        "jax.distributed world (default 1)")
+    p.add_argument("--min-world-size", type=int, dest="min_world_size",
+                   help="(--elastic) smallest admissible world: fewer "
+                        "surviving hosts forces a full-strength restart "
+                        "instead of a shrink (default 1)")
+    p.add_argument("--local-devices", type=int, default=1,
+                   help="(--elastic) accelerator devices per host — the "
+                        "planner's feasibility input: every admitted "
+                        "world's device count must divide global_batch "
+                        "(default 1)")
+    # Internal: injected by the elastic coordinator on each child so the
+    # child joins the generation's jax.distributed world.
+    p.add_argument("--elastic-rank", type=int, help=argparse.SUPPRESS)
+    p.add_argument("--elastic-world", type=int, help=argparse.SUPPRESS)
+    p.add_argument("--elastic-port", type=int, help=argparse.SUPPRESS)
+    p.add_argument("--elastic-generation", type=int, help=argparse.SUPPRESS)
 
 
 def _overrides(args) -> dict:
@@ -174,7 +202,7 @@ def _overrides(args) -> dict:
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
         "augment_translate_vox", "init_from", "inject_faults",
-        "alert_rules", "exec_cache_dir",
+        "alert_rules", "exec_cache_dir", "min_world_size",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
@@ -195,6 +223,8 @@ def _overrides(args) -> dict:
         out["augment"] = False
     if getattr(args, "hbm_cache", False):
         out["hbm_cache"] = True
+    if getattr(args, "elastic", False):
+        out["elastic"] = True
     if getattr(args, "augment_affine", False):
         out["augment_affine"] = True
     if getattr(args, "no_spatial", False):
@@ -705,6 +735,7 @@ def main(argv=None) -> None:
         and getattr(args, "restart_every_steps", None)
         and not getattr(args, "supervise", False)
         and not getattr(args, "supervised_child", False)
+        and not getattr(args, "elastic", False)
     ):
         # Without a supervisor, the child checkpoints and exits 75 at the
         # first segment boundary and nothing respawns it — the run silently
@@ -715,6 +746,94 @@ def main(argv=None) -> None:
             "(code 75) at every segment boundary and only the supervisor "
             "respawns it — without one, training silently stops at step N"
         )
+
+    if (
+        args.cmd == "train"
+        and getattr(args, "elastic", False)
+        and not getattr(args, "supervised_child", False)
+    ):
+        import sys
+
+        from featurenet_tpu.config import get_config
+        from featurenet_tpu.elastic import ElasticCoordinator, heartbeat_path
+        from featurenet_tpu.train.supervisor import child_argv_from_cli
+
+        if getattr(args, "supervise", False):
+            raise SystemExit(
+                "--elastic already supervises its world (it is the "
+                "N-host generalization of --supervise) — drop --supervise"
+            )
+        if not args.checkpoint_dir:
+            raise SystemExit(
+                "--elastic requires --checkpoint-dir: a re-formed mesh "
+                "resumes from the latest checkpoint, not from scratch"
+            )
+        if not getattr(args, "run_dir", None):
+            raise SystemExit(
+                "--elastic requires --run-dir: the membership file, "
+                "per-slot heartbeats, and the coordinator's event stream "
+                "live there"
+            )
+        # The planner's feasibility input: refuse an undividable global
+        # batch here, not in N spawned children — plan_world would
+        # otherwise *silently* form generation 0 below the requested
+        # strength (it picks the largest feasible world) and the
+        # operator would pay for provisioned hosts that never join.
+        cfg = get_config(args.config or "pod64", **_overrides(args))
+        if cfg.global_batch % (args.world_size * args.local_devices):
+            raise SystemExit(
+                f"--elastic: global batch {cfg.global_batch} is not "
+                f"divisible by world-size {args.world_size} x "
+                f"local-devices {args.local_devices} = "
+                f"{args.world_size * args.local_devices} device(s) — the "
+                "coordinator preserves the global batch across re-forms, "
+                "so the full-strength world could never form; adjust "
+                "--global-batch or the world shape"
+            )
+        raw = argv if argv is not None else sys.argv[1:]
+        run_dir = args.run_dir
+
+        def spawn(members, rank, generation, port):
+            child = child_argv_from_cli(
+                raw, heartbeat_path(run_dir, members[rank])
+            )
+            return child + [
+                "--elastic-rank", str(rank),
+                "--elastic-world", str(len(members)),
+                "--elastic-port", str(port),
+                "--elastic-generation", str(generation),
+            ]
+
+        if getattr(args, "inject_faults", None):
+            # Same split as --supervise: the coordinator process installs
+            # only its own site; the child-side sites must fire in the
+            # training processes.
+            from featurenet_tpu import faults
+
+            try:
+                faults.install(args.inject_faults, state_dir=run_dir,
+                               only={"spawn_fail"})
+            except ValueError as e:
+                raise SystemExit(f"--inject-faults: {e}")
+        result = ElasticCoordinator(
+            args.world_size,
+            spawn,
+            run_dir,
+            min_world_size=args.min_world_size or 1,
+            global_batch=cfg.global_batch,
+            local_devices=args.local_devices,
+            stall_timeout_s=args.stall_timeout,
+            max_reforms=args.max_restarts,
+        ).run()
+        print(json.dumps({"elastic": {
+            "exit_code": result.exit_code,
+            "generations": result.generations,
+            "reforms": result.reforms,
+            "losses": result.losses,
+            "rejoins": result.rejoins,
+            "planned": result.planned,
+        }}))
+        raise SystemExit(result.exit_code)
 
     if args.cmd == "train" and getattr(args, "supervise", False):
         import os
@@ -780,6 +899,27 @@ def main(argv=None) -> None:
         import jax
 
         jax.distributed.initialize()
+    elif getattr(args, "elastic_rank", None) is not None \
+            and (getattr(args, "elastic_world", None) or 0) > 1:
+        # Elastic child: join this generation's explicit world (the
+        # coordinator allocated the port; TPU-env discovery would hand
+        # back the FULL pod shape, not the surviving one).
+        import jax
+
+        try:
+            # CPU worlds (CI, laptop demos) need gloo for cross-process
+            # collectives on this jax line; safe here because the
+            # distributed client below always exists, and a TPU world's
+            # collectives ride ICI/DCN regardless. Newer jax dropped the
+            # knob (cross-process CPU works natively) — hence the guard.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{args.elastic_port}",
+            num_processes=args.elastic_world,
+            process_id=args.elastic_rank,
+        )
 
     if args.cmd == "bench":
         import bench

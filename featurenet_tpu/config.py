@@ -111,6 +111,19 @@ class Config:
     # Shard the voxel depth axis over 'model' (XLA conv halo exchange) — the
     # 128³-grids-outgrow-HBM path. Needs mesh_model > 1 to have any effect.
     spatial: bool = False
+    # Elastic multi-host training (featurenet_tpu.elastic, `cli train
+    # --elastic`): the run is owned by an elastic coordinator that
+    # re-forms the mesh at the surviving process count on host loss
+    # (resume from the latest checksummed checkpoint, per-host batch
+    # rescaled so global_batch is preserved) and re-admits recovered
+    # hosts at the next generation boundary. The flag is inert inside a
+    # training child (the coordinator launches before any backend);
+    # min_world_size is the smallest world the planner may form — fewer
+    # surviving hosts forces a full-strength restart instead of a
+    # shrink, and an unformable world is the coordinator's give-up
+    # verdict.
+    elastic: bool = False
+    min_world_size: int = 1
 
     # Planned periodic restart (supervised runs): exit cleanly-for-restart
     # every N steps after checkpointing; the supervisor (train.supervisor)
@@ -258,6 +271,18 @@ class Config:
         if self.seg_decoder_blocks < 1 or self.seg_bottleneck_blocks < 1:
             raise ValueError(
                 "seg_decoder_blocks and seg_bottleneck_blocks must be >= 1"
+            )
+        if self.min_world_size < 1:
+            raise ValueError(
+                f"min_world_size must be >= 1, got {self.min_world_size}"
+            )
+        if self.min_world_size != 1 and not self.elastic:
+            # Parse-and-ignore refusal (the same convention as the affine
+            # knobs): a world-size floor only means something to the
+            # elastic coordinator.
+            raise ValueError(
+                "min_world_size configured but elastic is off — the floor "
+                "would be silently ignored; pass elastic=True (--elastic)"
             )
         if self.restart_every_steps is not None:
             if self.restart_every_steps <= 0:

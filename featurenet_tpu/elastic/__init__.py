@@ -1,0 +1,41 @@
+"""Elastic multi-host training (ROADMAP item 3).
+
+The plain supervisor (``train.supervisor``) respawns a fixed-shape world:
+lose one host of an N-host mesh and training is dead until that exact
+host returns. This package makes the world **elastic**:
+
+- ``membership``: the durable membership file + generation counter in
+  ``run_dir`` — which host slots form the current mesh, and why.
+- ``planner``: the re-mesh planner — given the surviving hosts, pick the
+  largest world the global batch divides over (global batch is
+  *preserved* across re-forms; the per-host share rescales).
+- ``coordinator``: the elastic coordinator — an N-child supervisor that
+  detects host loss (death or stalled heartbeat), **shrinks** the mesh to
+  the survivors (respawn from the latest checksummed checkpoint at the
+  new world shape), and **grows** it back by re-admitting recovered
+  hosts at the next generation boundary (an exit-75 planned cut).
+
+FeatureNet training is pure data parallelism over the classifier, so the
+model admits any mesh size >= 1; the pieces this composes — per-host
+event streams, exit-75 planned restarts, crash-loop backoff, checksummed
+checkpoints, the runtime registry's rebuild-on-any-mesh — shipped in the
+ops-layer PRs and are reused here, not reimplemented.
+"""
+
+from featurenet_tpu.elastic.coordinator import (  # noqa: F401
+    ElasticCoordinator,
+    ElasticResult,
+    heartbeat_path,
+)
+from featurenet_tpu.elastic.membership import (  # noqa: F401
+    MEMBERSHIP_FILENAME,
+    Membership,
+    read_membership,
+    write_membership,
+)
+from featurenet_tpu.elastic.planner import (  # noqa: F401
+    InfeasibleWorld,
+    feasible_world_sizes,
+    per_host_batch,
+    plan_world,
+)

@@ -1,0 +1,467 @@
+"""The elastic coordinator: an N-child supervisor that re-forms the mesh.
+
+``train.supervisor.supervise`` watches ONE child and respawns the same
+world shape; this coordinator owns a *generation* of N training
+processes (one per host slot) and makes the world shape itself a
+recovery lever:
+
+- **Host loss** (a child dies without the planned exit code, or its
+  heartbeat goes stale): the whole generation is killed — a lockstep
+  mesh with a dead member is wedged in its next collective, nothing
+  softer than SIGKILL is guaranteed to land — the lost slot is removed,
+  and the survivors are respawned as generation G+1 at the smaller
+  world. Each child resumes from the latest checksummed checkpoint
+  (``CheckpointManager.restore`` walks back past torn steps and the
+  state template carries the *new* mesh's shardings, so the restore is
+  the reshard) with the per-host batch rescaled — the global batch is
+  preserved by the planner's feasibility rule.
+- **Host recovery**: a lost slot is re-admitted at the next generation
+  boundary — an all-exit-75 planned cut (``restart_every_steps``, a
+  drained SIGTERM) — so growth never interrupts a healthy generation.
+  A re-admitted host that fails to come up is shed again as a startup
+  loss; it does not take the run down.
+- **Full-world loss** (the crash took every remaining slot below
+  ``min_world_size``): every lost slot is re-admitted immediately and
+  the world restarts at full shape on the reform budget — the
+  degenerate case is exactly the plain supervisor's respawn.
+
+One loss verdict per reform: when several children die near-
+simultaneously, only the FIRST observed death is charged as a host loss
+— the rest are the cascade of a mesh losing a member (peers error out
+of their collectives within the same poll window) and of the
+coordinator's own kill, and shedding them too would shrink a healthy
+fleet to nothing on one bad host.
+
+Every decision lands in host 0's event stream (the supervisor's
+convention): ``supervisor`` phase events for spawn/stall/backoff/
+planned_restart/done/giving_up, plus the elastic kinds —
+``mesh_reform{generation, from_n, to_n, reason}`` on every shape change,
+``host_leave``/``host_join`` per slot. ``cli report`` folds them into
+the recovery section; ``membership.json`` in the run dir is the durable
+snapshot an external host agent polls.
+
+Stdlib-only: the coordinator process never initializes a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, Optional, Sequence
+
+from featurenet_tpu import faults
+from featurenet_tpu.elastic.membership import Membership, write_membership
+from featurenet_tpu.elastic.planner import InfeasibleWorld, plan_world
+from featurenet_tpu.train.supervisor import (
+    RESTART_EXIT_CODE,
+    _kill_tree,
+    touch_heartbeat,
+)
+
+
+def heartbeat_path(run_dir: str, slot: int) -> str:
+    """Per-slot heartbeat file (the coordinator and the spawn-argv
+    builder must agree on the path, so it is a convention, not a
+    parameter)."""
+    return os.path.join(os.path.abspath(run_dir), f"heartbeat.{int(slot)}")
+
+
+def _free_port() -> int:
+    """An ephemeral port for the generation's jax.distributed
+    coordinator (rank 0 binds it; each generation gets a fresh one so a
+    SIGKILLed generation's half-dead service can never confuse the
+    next)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class ElasticResult:
+    exit_code: int      # 0 = the run completed its full step budget
+    generations: int    # generations formed (including generation 0)
+    reforms: int        # shape-changing re-forms (shrink + grow)
+    losses: int         # host-loss verdicts
+    rejoins: int        # slots re-admitted
+    planned: int        # all-exit-75 generation boundaries
+
+
+@dataclasses.dataclass
+class _GenOutcome:
+    kind: str           # "done" | "planned" | "loss" | "startup"
+    dead: set           # slots charged as lost (kind == "loss")
+    beats: set          # slots that produced at least one heartbeat
+    exits: dict         # slot -> exit code (kill victims included)
+    reason: str
+
+
+class ElasticCoordinator:
+    """Supervise an elastic world of up to ``n_hosts`` training
+    processes.
+
+    Args:
+      n_hosts: host slots at full strength (slot ids ``0..n_hosts-1``).
+      spawn: ``(members, rank, generation, port) -> argv`` — the child
+        command for ``members[rank]``. The child must touch
+        ``heartbeat_path(run_dir, members[rank])``, run its
+        ``jax.distributed`` world over ``127.0.0.1:<port>`` when
+        ``len(members) > 1``, and follow the supervisor exit protocol
+        (0 done, 75 planned restart, anything else a crash).
+      run_dir: the shared run directory — membership file, heartbeat
+        files, fault markers, and host 0's event stream all live here.
+      min_world_size: smallest admissible world; fewer surviving hosts
+        than this forces the full-restart path (and, if even full
+        strength can't form, the give-up verdict).
+      global_batch / local_devices: the planner's feasibility inputs —
+        the preserved global batch must divide every admitted world's
+        data axis.
+      stall_timeout_s / grace_s / poll_s / backoff_*: the plain
+        supervisor's knobs, applied per slot.
+      max_reforms: unplanned re-forms (loss, full restart, startup
+        retry) allowed before giving up; planned boundaries are free.
+      env: environment for every child (None = inherit).
+    """
+
+    def __init__(
+        self,
+        n_hosts: int,
+        spawn: Callable[[Sequence[int], int, int, int], list],
+        run_dir: str,
+        *,
+        min_world_size: int = 1,
+        global_batch: int = 1,
+        local_devices: int = 1,
+        stall_timeout_s: float = 600.0,
+        grace_s: Optional[float] = None,
+        poll_s: float = 5.0,
+        max_reforms: int = 8,
+        backoff_base_s: float = 1.0,
+        backoff_cap_s: float = 60.0,
+        env: Optional[dict] = None,
+        log=print,
+    ):
+        if n_hosts < 1:
+            raise ValueError(f"n_hosts must be >= 1, got {n_hosts}")
+        self.n_hosts = n_hosts
+        self.spawn = spawn
+        self.run_dir = os.path.abspath(run_dir)
+        self.min_world_size = min_world_size
+        self.global_batch = global_batch
+        self.local_devices = local_devices
+        self.stall_timeout_s = stall_timeout_s
+        self.grace_s = grace_s if grace_s is not None else max(
+            stall_timeout_s, 600.0
+        )
+        self.poll_s = poll_s
+        self.max_reforms = max_reforms
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.env = env
+        self.log = log
+        self._spawns = 0
+        self._rng = random.Random()  # backoff jitter; never test-visible
+
+    # -- one generation -------------------------------------------------------
+
+    def _run_generation(self, members: Sequence[int], generation: int,
+                        port: int, record) -> _GenOutcome:
+        hbs = {slot: heartbeat_path(self.run_dir, slot) for slot in members}
+        base: dict[int, float] = {}
+        for slot, hb in hbs.items():
+            # Fresh baseline per spawn: only a NEWER mtime proves this
+            # generation's child beat (the supervisor's protocol).
+            touch_heartbeat(hb)
+            base[slot] = os.path.getmtime(hb)
+        procs: dict[int, subprocess.Popen] = {}
+        for rank, slot in enumerate(members):
+            self._spawns += 1
+            argv = list(self.spawn(list(members), rank, generation, port))
+            if faults.maybe_fail("spawn_fail", spawn=self._spawns):
+                argv = [sys.executable, "-c", "raise SystemExit(13)"]
+            procs[slot] = subprocess.Popen(
+                argv, start_new_session=True, env=self.env
+            )
+            self.log(json.dumps({
+                "coordinator": "spawn", "host": slot, "rank": rank,
+                "generation": generation, "pid": procs[slot].pid,
+            }))
+            record("spawn", host=slot, rank=rank, generation=generation,
+                   pid=procs[slot].pid)
+        started = time.monotonic()
+        beats: set[int] = set()
+        self_exits: dict[int, int] = {}
+        stalled: Optional[int] = None
+        first_crash: Optional[int] = None
+        while True:
+            # Complete the sweep before judging: breaking at the first
+            # dead slot would make the loss verdict an artifact of dict
+            # order — a preempted slot 1 whose rank-0 peer errored out of
+            # the wedged collective inside the same poll window would
+            # read as "slot 0 died first" and shed the healthy host that
+            # owns the primary event stream.
+            sweep_dead: list[int] = []
+            for slot, p in procs.items():
+                if slot in self_exits:
+                    continue
+                rc = p.poll()
+                if rc is not None:
+                    self_exits[slot] = rc
+                    if rc not in (0, RESTART_EXIT_CODE):
+                        sweep_dead.append(slot)
+            if sweep_dead and first_crash is None:
+                # One loss verdict per reform: THE loss is the first
+                # observed death; peers dead in the same sweep are its
+                # cascade (see module docstring). Within one sweep the
+                # order is unobservable, so prefer the death that LOOKS
+                # like a host loss — killed by a signal (preemption,
+                # OOM-kill, yanked node), not a collective/runtime error
+                # exiting through Python.
+                first_crash = next(
+                    (s for s in sweep_dead if self_exits[s] < 0),
+                    sweep_dead[0],
+                )
+            if first_crash is not None or len(self_exits) == len(procs):
+                break
+            time.sleep(self.poll_s)
+            for slot in members:
+                if slot in self_exits:
+                    continue
+                try:
+                    mtime = os.path.getmtime(hbs[slot])
+                except OSError:
+                    # Deleted externally: recreate (a dead coordinator
+                    # orphans the whole generation) and restart the clock.
+                    touch_heartbeat(hbs[slot])
+                    mtime = base[slot] = os.path.getmtime(hbs[slot])
+                if mtime > base[slot]:
+                    beats.add(slot)
+                # lint: allow-wall-clock(file mtimes are epoch-based)
+                age = time.time() - mtime
+                if slot not in beats:
+                    if time.monotonic() - started > self.grace_s:
+                        stalled = slot
+                elif age > self.stall_timeout_s:
+                    # Re-read before the verdict: a beat can land between
+                    # the sample above and here, and a SIGKILL on a live
+                    # mesh costs a whole-generation restart for nothing.
+                    try:
+                        # lint: allow-wall-clock(file mtimes are epoch-based)
+                        age = time.time() - os.path.getmtime(hbs[slot])
+                    except OSError:
+                        pass
+                    if age > self.stall_timeout_s:
+                        stalled = slot
+                if stalled is not None:
+                    break
+            if stalled is not None:
+                self.log(json.dumps({
+                    "coordinator": "stall", "host": stalled,
+                    "generation": generation,
+                }))
+                record("stall", host=stalled, generation=generation)
+                break
+        if first_crash is not None:
+            # A fast-failing WORLD (bad flag, broken cache) staggers its
+            # self-exits across spawn order; give the peers one short
+            # window to also die on their own before the kill below
+            # would turn them into "survivors we killed" — the
+            # startup-vs-loss discriminator. A genuinely isolated crash
+            # leaves peers mid-compile/mid-step; they never exit here.
+            deadline = time.monotonic() + min(self.poll_s, 0.5)
+            while time.monotonic() < deadline \
+                    and any(s not in self_exits for s in procs):
+                for slot, p in procs.items():
+                    if slot not in self_exits:
+                        rc = p.poll()
+                        if rc is not None:
+                            self_exits[slot] = rc
+                time.sleep(0.02)
+        # Final beat sweep (a beat may have landed inside the last poll
+        # window) BEFORE the kills below can freeze the mtimes.
+        for slot in members:
+            try:
+                if os.path.getmtime(hbs[slot]) > base[slot]:
+                    beats.add(slot)
+            except OSError:
+                pass
+        exits = dict(self_exits)
+        if first_crash is not None or stalled is not None:
+            survivors_killed = 0
+            for slot, p in procs.items():
+                if p.poll() is None:
+                    survivors_killed += 1
+                    _kill_tree(p)
+                exits.setdefault(slot, p.returncode)
+            dead = {stalled} if stalled is not None else {first_crash}
+            reason = ("stall" if stalled is not None
+                      else f"exit_{self_exits[first_crash]}")
+            if not beats and not survivors_killed:
+                # Every member self-exited before anyone came up — a
+                # deterministic whole-generation startup failure (bad
+                # flag, broken cache), not a host dying under load;
+                # shrinking would misdiagnose it. If the coordinator had
+                # to kill live peers, the crash was ISOLATED — one bad
+                # host in an otherwise-healthy world still climbing
+                # through backend init/compile/restore — and that host
+                # must be shed (kind "loss"), not allowed to take the
+                # whole run down via the startup-fails-twice verdict.
+                return _GenOutcome("startup", set(), beats, exits, reason)
+            return _GenOutcome("loss", dead, beats, exits, reason)
+        for slot, p in procs.items():
+            p.wait()
+        if all(rc == 0 for rc in exits.values()):
+            return _GenOutcome("done", set(), beats, exits, "done")
+        if beats:
+            # Uniform exit-75 (or a 0/75 mix at the budget edge): the
+            # generation checkpointed and asked for a fresh world — the
+            # boundary where growth happens.
+            return _GenOutcome("planned", set(), beats, exits, "planned")
+        return _GenOutcome("startup", set(), beats, exits,
+                           "exit_75_before_first_heartbeat")
+
+    # -- the generation loop --------------------------------------------------
+
+    def run(self) -> ElasticResult:
+        from featurenet_tpu.obs.events import EventSink, events_filename
+
+        sink = EventSink(self.run_dir, filename=events_filename(0))
+
+        def record(phase: str, **fields) -> None:
+            sink.emit("supervisor", phase=phase, **fields)
+
+        avail = set(range(self.n_hosts))
+        lost: dict[int, int] = {}  # slot -> generation it was lost in
+        generation = 0
+        prev_n = 0
+        reason = "start"
+        reforms = losses = rejoins = planned = 0
+        reforms_used = 0
+        startup_fails = 0
+        consec_failures = 0
+
+        def give_up(why: str, code: int) -> ElasticResult:
+            self.log(json.dumps({"coordinator": "giving_up", "reason": why}))
+            record("giving_up", reason=why, generation=generation,
+                   losses=losses, reforms=reforms)
+            sink.close()
+            return ElasticResult(code if code else 1, generation + 1,
+                                 reforms, losses, rejoins, planned)
+
+        while True:
+            try:
+                members = plan_world(
+                    avail,
+                    min_world_size=self.min_world_size,
+                    global_batch=self.global_batch,
+                    local_devices=self.local_devices,
+                )
+            except InfeasibleWorld as e:
+                return give_up(str(e), 1)
+            if len(members) != prev_n:
+                sink.emit("mesh_reform", generation=generation,
+                          from_n=prev_n, to_n=len(members), reason=reason)
+                self.log(json.dumps({
+                    "coordinator": "mesh_reform", "generation": generation,
+                    "from_n": prev_n, "to_n": len(members), "reason": reason,
+                }))
+                if prev_n:
+                    reforms += 1
+            write_membership(self.run_dir, Membership(
+                generation=generation,
+                members=tuple(members),
+                min_world_size=self.min_world_size,
+                reason=reason,
+            ))
+            out = self._run_generation(
+                members, generation, _free_port(), record
+            )
+            if out.kind == "done":
+                self.log(json.dumps({
+                    "coordinator": "done", "generation": generation,
+                    "world_size": len(members), "losses": losses,
+                    "rejoins": rejoins, "planned": planned,
+                }))
+                record("done", generation=generation,
+                       world_size=len(members), losses=losses,
+                       rejoins=rejoins, planned=planned)
+                sink.close()
+                return ElasticResult(0, generation + 1, reforms, losses,
+                                     rejoins, planned)
+            if out.kind == "planned":
+                planned += 1
+                consec_failures = 0
+                startup_fails = 0
+                record("planned_restart", count=planned,
+                       generation=generation)
+                generation += 1
+                prev_n = len(members)
+                if lost:
+                    # The generation boundary is where recovered hosts
+                    # rejoin: every lost slot is offered the next world;
+                    # one that is still dead fails startup and is shed
+                    # again without taking the run down.
+                    for slot in sorted(lost):
+                        sink.emit("host_join", host=slot,
+                                  generation=generation)
+                        rejoins += 1
+                    avail |= set(lost)
+                    lost.clear()
+                    reason = "host_rejoin"
+                else:
+                    reason = "planned"
+                continue
+            # Unplanned: a loss or a whole-generation startup failure.
+            reforms_used += 1
+            if out.kind == "startup":
+                startup_fails += 1
+                if startup_fails >= 2:
+                    return give_up(
+                        f"{out.reason} twice — deterministic startup "
+                        "failure", max(out.exits.values(), default=1),
+                    )
+                reason = "restart"
+            else:
+                startup_fails = 0
+                for slot in sorted(out.dead):
+                    losses += 1
+                    sink.emit("host_leave", host=slot,
+                              generation=generation, reason=out.reason)
+                    avail.discard(slot)
+                    lost[slot] = generation
+                reason = "host_loss"
+                if len(avail) < self.min_world_size:
+                    # Full-world loss: below the floor there is no mesh
+                    # to shrink to — re-admit everything and restart at
+                    # strength (the plain supervisor's move), still on
+                    # the reform budget.
+                    for slot in sorted(lost):
+                        sink.emit("host_join", host=slot,
+                                  generation=generation + 1)
+                        rejoins += 1
+                    avail |= set(lost)
+                    lost.clear()
+                    reason = "restart"
+            if reforms_used > self.max_reforms:
+                return give_up(
+                    f"reform budget exhausted ({self.max_reforms})",
+                    max(out.exits.values(), default=1),
+                )
+            # Crash-loop backoff, shared shape with the supervisor's: a
+            # deterministic crash at full respawn speed would burn the
+            # reform budget in seconds.
+            consec_failures += 1
+            delay = min(self.backoff_cap_s,
+                        self.backoff_base_s * (2 ** (consec_failures - 1)))
+            delay *= 0.5 + 0.5 * self._rng.random()
+            if delay > 0:
+                record("backoff", delay_s=round(delay, 3),
+                       consecutive_failures=consec_failures)
+                time.sleep(delay)
+            generation += 1
+            prev_n = len(members)

@@ -1,0 +1,83 @@
+"""The membership file: which host slots form the mesh, and since when.
+
+One JSON document (``membership.json``) in the run directory, rewritten
+atomically at every mesh re-form. It is the durable half of the elastic
+protocol: children are told their world shape on their argv (the
+coordinator owns the live decision), but the *file* is what an external
+host agent — or an operator mid-incident — reads to answer "what
+generation is this run on, at what size, and why": a recovered host's
+agent polls it to learn that the mesh shrank without it and that it
+should ask to rejoin, and the post-mortem reads the final generation
+straight from the run dir next to the event streams that explain it.
+
+Stdlib-only, like the rest of the run-dir protocol (heartbeat files,
+fault markers, gate baselines): the coordinator process never imports a
+backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+MEMBERSHIP_FILENAME = "membership.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Membership:
+    """One generation's mesh: the slot ids that form it, ordered — the
+    rank of a member is its index in ``members``."""
+
+    generation: int
+    members: tuple[int, ...]
+    min_world_size: int
+    reason: str  # "start" | "host_loss" | "host_rejoin" | "planned" | ...
+
+    @property
+    def world_size(self) -> int:
+        return len(self.members)
+
+
+def membership_path(run_dir: str) -> str:
+    return os.path.join(os.path.abspath(run_dir), MEMBERSHIP_FILENAME)
+
+
+def write_membership(run_dir: str, m: Membership) -> str:
+    """Atomically persist ``m`` (tmp + rename — a coordinator killed
+    mid-write must never leave half a membership for an agent to act on).
+    Returns the path written."""
+    path = membership_path(run_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {
+        "generation": m.generation,
+        "world_size": m.world_size,
+        "members": list(m.members),
+        "min_world_size": m.min_world_size,
+        "reason": m.reason,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def read_membership(run_dir: str) -> Optional[Membership]:
+    """The persisted membership, or ``None`` when the run never wrote one
+    (a non-elastic run, or a coordinator that died before generation 0).
+    A torn/garbled file also reads as ``None`` — the writer is atomic, so
+    garbage means something else wrote here; acting on it would be worse
+    than "unknown"."""
+    try:
+        with open(membership_path(run_dir), encoding="utf-8") as fh:
+            doc = json.load(fh)
+        return Membership(
+            generation=int(doc["generation"]),
+            members=tuple(int(s) for s in doc["members"]),
+            min_world_size=int(doc.get("min_world_size", 1)),
+            reason=str(doc.get("reason", "")),
+        )
+    except (OSError, ValueError, KeyError, TypeError):
+        return None

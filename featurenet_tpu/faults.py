@@ -76,6 +76,12 @@ SITES = {
     "sink_enospc": "emit",               # Nth EventSink.emit
     "spawn_fail": "spawn",               # Nth supervisor child spawn
     "save_slow": "save",                 # Nth CheckpointManager.save (latency)
+    # A host vanishing mid-mesh (preempted VM, kernel panic, yanked node):
+    # the LAST host of the process group SIGKILLs itself at the first step
+    # boundary >= N — no drain, no exit protocol, exactly the shape the
+    # elastic coordinator must detect and shrink around. Last host (not
+    # first) so host 0's event stream and run.json survive the loss.
+    "host_loss": "step",                 # exact train-loop step number
 }
 
 # How long the latency-injection sites (producer_slow, save_slow) sleep

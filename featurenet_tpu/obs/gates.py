@@ -86,6 +86,20 @@ DIRECTIONS = {
     "serve_p99_ms": "max",
     "serve_occupancy": "min",
     "serve_rejected": "max",
+    # Scaling-efficiency gate (the MULTICHIP_r0*.json series made
+    # self-policing): per-chip train throughput at each power-of-two
+    # data-mesh shape (benchmark.measure_scaling) regresses DOWNWARD,
+    # as does the retention ratio (largest shape's per-chip rate over
+    # the single-chip rate — a lockstep mesh leaking throughput to the
+    # slowest member shows up here before anyone reads a host table).
+    "scaling_sps_per_chip_1x": "min",
+    "scaling_sps_per_chip_2x": "min",
+    "scaling_sps_per_chip_4x": "min",
+    "scaling_sps_per_chip_8x": "min",
+    "scaling_sps_per_chip_16x": "min",
+    "scaling_sps_per_chip_32x": "min",
+    "scaling_sps_per_chip_64x": "min",
+    "scaling_efficiency": "min",
 }
 
 
@@ -149,6 +163,19 @@ BENCH_GATE_KEYS = (
     "serve_p99_ms",
     "serve_occupancy",
     "serve_rejected",
+    # Scaling-efficiency gate: samples/sec per mesh shape plus the
+    # cross-host data-wait spread of the 2-host probe run — present only
+    # when the round could measure them (device count / probe success),
+    # like the e2e block on a cache-less round.
+    "scaling_sps_per_chip_1x",
+    "scaling_sps_per_chip_2x",
+    "scaling_sps_per_chip_4x",
+    "scaling_sps_per_chip_8x",
+    "scaling_sps_per_chip_16x",
+    "scaling_sps_per_chip_32x",
+    "scaling_sps_per_chip_64x",
+    "scaling_efficiency",
+    "data_wait_spread",
 )
 
 

@@ -496,17 +496,23 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
                 for e in sup
             ],
         }
-    # Recovery events: preemptions drained to a checkpoint and restores
-    # that fell back past a corrupt step — every host's stream counts (a
-    # preempted host ≠ host 0 in general).
+    # Recovery events: preemptions drained to a checkpoint, restores
+    # that fell back past a corrupt step, and the elastic membership
+    # timeline (mesh re-forms with their per-slot leave/join
+    # transitions) — every host's stream counts (a preempted host ≠
+    # host 0 in general; the coordinator writes into host 0's).
     rec = [e for e in events
-           if e["ev"] in ("preempt", "checkpoint_fallback")]
+           if e["ev"] in ("preempt", "checkpoint_fallback", "mesh_reform",
+                          "host_leave", "host_join")]
     if rec:
         rep["recovery"] = {
             "preempts": sum(e["ev"] == "preempt" for e in rec),
             "checkpoint_fallbacks": sum(
                 e["ev"] == "checkpoint_fallback" for e in rec
             ),
+            "mesh_reforms": sum(e["ev"] == "mesh_reform" for e in rec),
+            "host_leaves": sum(e["ev"] == "host_leave" for e in rec),
+            "host_joins": sum(e["ev"] == "host_join" for e in rec),
             "timeline": [
                 {"t": round(e["t"], 3), "event": e["ev"],
                  **{k: v for k, v in e.items()
@@ -784,6 +790,8 @@ def format_report(rep: dict) -> str:
         lines.append(
             f"recovery: {rc['preempts']} preemption(s), "
             f"{rc['checkpoint_fallbacks']} checkpoint fallback(s)"
+            + (f", {rc['mesh_reforms']} mesh re-form(s)"
+               if rc.get("mesh_reforms") else "")
         )
         for e in rc["timeline"]:
             detail = {k: v for k, v in e.items() if k not in ("t", "event")}
@@ -1009,6 +1017,11 @@ KNOWN_EVENT_KINDS = frozenset({
     # bucket ladder, one dispatched batch (bucket/fill/padding), one
     # admission fast-reject at the queue bound, and the drain record.
     "serve_start", "serve_batch", "overload", "serve_stop",
+    # Elastic membership (featurenet_tpu.elastic): the coordinator
+    # re-formed the mesh at a new world size (shrink on host loss, grow
+    # on re-admission), and the per-slot transitions — a host charged as
+    # lost, a recovered host re-admitted at a generation boundary.
+    "mesh_reform", "host_leave", "host_join",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -1032,6 +1045,9 @@ REQUIRED_EVENT_FIELDS = {
     "serve_batch": ("bucket", "n"),
     "overload": ("queue_depth", "limit"),
     "serve_stop": ("served", "rejected"),
+    "mesh_reform": ("generation", "from_n", "to_n", "reason"),
+    "host_leave": ("host", "generation", "reason"),
+    "host_join": ("host", "generation"),
 }
 
 # Required at EMIT sites (the analysis linter holds new code to the full

@@ -51,6 +51,22 @@ def make_mesh(
     return Mesh(grid, axis_names=("data", "model"))
 
 
+def mesh_summary(mesh: Mesh) -> dict:
+    """JSON-able identity of a mesh: axis sizes plus the device/process
+    footprint. Two consumers need more than ``mesh.shape``: the runtime
+    registry's executable-cache fingerprint (the same axis sizes laid
+    over a different process count compile different cross-host
+    collectives — an elastic re-form must never be served the old
+    world's executable) and the run log's ``loop_start`` (so the report
+    can attribute each segment to the mesh shape that ran it across
+    elastic generations)."""
+    return {
+        **{k: int(v) for k, v in mesh.shape.items()},
+        "devices": int(mesh.devices.size),
+        "processes": len({d.process_index for d in mesh.devices.flat}),
+    }
+
+
 def feed_shards(mesh: Mesh) -> tuple[int, int]:
     """How the *host data feed* shards the global batch on this process.
 

@@ -522,10 +522,16 @@ class Runtime:
         self._abstract_variables = None
         # Fingerprint identity: the full config identity fields (arch
         # INCLUDING conv_backend — a different lowering is a different
-        # executable) plus the mesh/layout decisions baked into shardings.
+        # executable) plus the mesh/layout decisions baked into
+        # shardings. mesh_summary, not mesh.shape: the same axis sizes
+        # laid over a different process count compile different
+        # cross-host collectives, and an elastic re-form at a new world
+        # shape must never be served the old world's executable.
+        from featurenet_tpu.parallel.mesh import mesh_summary
+
         ident = config_to_dict(cfg)
         self._identity = {f: ident[f] for f in IDENTITY_FIELDS}
-        self._identity["mesh"] = dict(self.mesh.shape)
+        self._identity["mesh"] = mesh_summary(self.mesh)
         self._identity["spatial"] = bool(self.spatial)
 
     # -- shared abstract structures ------------------------------------------

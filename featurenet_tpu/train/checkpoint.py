@@ -204,6 +204,23 @@ class CheckpointManager:
             "batch_stats": state.batch_stats,
             "opt_state": state.opt_state,
         }
+        # Snapshot BEFORE the async write: train_step DONATES the state,
+        # and on the CPU backend Orbax's background writer serializes
+        # zero-copy numpy *views* of these very buffers — the next
+        # dispatch then rewrites them under the writer and the committed
+        # (and checksummed!) checkpoint holds another array's bytes.
+        # Surfaced by the elastic shrink e2e, the first consumer to
+        # restore a mid-run checkpoint written at full speed (every
+        # earlier recovery path restored a drain/final save, after which
+        # nothing donates). A device-side copy stays inside jax's
+        # dataflow, so the donation is ordered after it; the copy's own
+        # buffers are never donated, so the writer's views stay valid.
+        import jax.numpy as jnp
+
+        payload = jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            payload,
+        )
         # Async save: this span is the host-blocking enqueue only; the
         # background write's completion is bounded by checkpoint_wait.
         self._saves += 1

@@ -309,7 +309,12 @@ class Trainer:
 
         Called only after evidence the *device* is advancing (a completed
         readback / eval / checkpoint) — never on mere dispatch, which
-        succeeds even when the backend is hung.
+        succeeds even when the backend is hung. Deliberately NOT at loop
+        entry either: the first beat arms the supervisor's stall clock,
+        and before the first train-step compile only the (longer) grace
+        window may govern. The elastic coordinator tells a pre-first-beat
+        host loss from a startup failure by whether it had to kill live
+        peers, not by beats.
         """
         # perf_counter, not time.time(): the inter-beat age is a process-
         # local interval and must not jump when NTP steps the wall clock
@@ -534,8 +539,14 @@ class Trainer:
             pass
         preempted = False
         # Loop window markers: the report attributes span time to the
-        # step-time breakdown only between these two events.
-        obs.emit("loop_start", step=start, stop=stop, total=total)
+        # step-time breakdown only between these two events. The mesh
+        # summary + global batch ride along so an elastic run's report
+        # can show, per generation, the world shape each segment ran at
+        # — and that the global batch was preserved across re-forms.
+        from featurenet_tpu.parallel.mesh import mesh_summary
+
+        obs.emit("loop_start", step=start, stop=stop, total=total,
+                 mesh=mesh_summary(self.mesh), global_batch=cfg.global_batch)
         loop_t0 = time.perf_counter()
         last = {}
         # Resume-safe profiling window: anchored at the first step this run
@@ -616,6 +627,17 @@ class Trainer:
                     # run-dir marker keeps the resumed process — whose
                     # steps also sit past N — from re-firing.
                     os.kill(os.getpid(), signal.SIGTERM)
+                if (faults.active()
+                        and jax.process_index() == jax.process_count() - 1
+                        and faults.maybe_fail("host_loss", step=step)):
+                    # Scripted host loss: SIGKILL self — no drain, no exit
+                    # code, mid-everything; the rest of the mesh wedges in
+                    # its next collective, which is exactly what the
+                    # elastic coordinator must detect and shrink around.
+                    # Only the LAST host checks (a single deterministic
+                    # casualty; host 0's stream and run.json survive), so
+                    # the shared run-dir marker is never raced.
+                    os.kill(os.getpid(), signal.SIGKILL)
                 if self._preempted and step < total:
                     preempted = True
                     obs.emit("preempt", step=int(step))

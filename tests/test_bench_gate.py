@@ -1,0 +1,151 @@
+"""bench.py hardening + the scaling-efficiency gate plumbing.
+
+The BENCH_r05 artifact died with a raw traceback and ``parsed: null``
+when the backend was lost MID-measurement (probe passed, then
+``jax.devices()`` raised inside ``measure_train_step``): these tests pin
+the structured ``{"skipped": true, "reason": "backend_lost", ...}``
+degradation, and the round's new scaling gate keys (samples/sec per
+mesh shape + cross-host data-wait spread) flowing into ``gate_summary``
+via ``BENCH_GATE_KEYS`` with the right regression directions.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import bench
+
+
+def _last_record(capsys) -> dict:
+    out = capsys.readouterr().out
+    for line in reversed(out.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise AssertionError(f"no JSON record in bench output: {out!r}")
+
+
+@pytest.fixture
+def quiet_lint(monkeypatch):
+    """Skip the round's lint preamble (covered by test_analysis; here it
+    only adds seconds to every bench.main() call)."""
+    import featurenet_tpu.analysis as analysis
+
+    monkeypatch.setattr(analysis, "run_lint", lambda *a, **k: [])
+
+
+def test_mid_measurement_backend_loss_is_structured_skip(
+        monkeypatch, capsys, quiet_lint):
+    """The r05 shape: the probe says the TPU is fine, then the backend
+    dies inside the measurement. The artifact must be one parseable line
+    with reason backend_lost — never a raw traceback."""
+    monkeypatch.setattr(bench, "_probe_backend", lambda: ("tpu", None))
+
+    def lost(platform):
+        raise RuntimeError(
+            "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+            "backend setup/compile error (Unavailable)."
+        )
+
+    monkeypatch.setattr(bench, "_measure_round", lost)
+    bench.main()  # must not raise
+    rec = _last_record(capsys)
+    assert rec["skipped"] is True
+    assert rec["reason"] == "backend_lost"
+    assert rec["backend"] == "tpu"
+    assert "UNAVAILABLE" in rec["error"]
+
+
+def test_non_backend_measurement_error_keeps_generic_reason(
+        monkeypatch, capsys, quiet_lint):
+    """A bug in the measurement itself must not masquerade as an infra
+    outage — the two reasons route to different operators."""
+    monkeypatch.setattr(bench, "_probe_backend", lambda: ("tpu", None))
+
+    def bug(platform):
+        raise ValueError("shape mismatch in slope window")
+
+    monkeypatch.setattr(bench, "_measure_round", bug)
+    bench.main()
+    rec = _last_record(capsys)
+    assert rec["skipped"] is True
+    assert rec["reason"] == "measurement_error"
+    assert "shape mismatch" in rec["error"]
+
+
+def test_backend_loss_classifier_signatures():
+    assert bench._is_backend_loss(
+        "jax.errors.JaxRuntimeError: UNAVAILABLE: ..."
+    )
+    assert bench._is_backend_loss("RuntimeError: Unable to initialize "
+                                  "backend 'axon'")
+    assert not bench._is_backend_loss("ValueError: bad shape (4, 3)")
+
+
+# --- scaling-efficiency gate plumbing ----------------------------------------
+
+def test_scaling_gate_keys_flow_into_gate_summary():
+    """The MULTICHIP series' numbers, as pins: per-shape samples/sec and
+    the efficiency ratio regress downward, the cross-host data-wait
+    spread upward — and all of them ride BENCH_GATE_KEYS into the
+    pin-ready gate_summary."""
+    from featurenet_tpu.obs import gates
+
+    summary = {
+        "value": 16000.0,
+        "scaling_sps_per_chip_1x": 100.0,
+        "scaling_sps_per_chip_2x": 96.0,
+        "scaling_sps_per_chip_4x": 91.0,
+        "scaling_efficiency": 0.91,
+        "data_wait_spread": 0.02,
+        "unrelated": "dropped",
+    }
+    vals = gates.bench_gate_values(summary)
+    for key in ("scaling_sps_per_chip_1x", "scaling_sps_per_chip_2x",
+                "scaling_sps_per_chip_4x", "scaling_efficiency",
+                "data_wait_spread"):
+        assert key in gates.BENCH_GATE_KEYS
+        assert vals[key] == summary[key]
+    assert "unrelated" not in vals
+    baseline = gates.make_baseline(vals)
+    for key in ("scaling_sps_per_chip_1x", "scaling_efficiency"):
+        assert baseline["gates"][key]["direction"] == "min"
+    assert baseline["gates"]["data_wait_spread"]["direction"] == "max"
+    # A lockstep mesh leaking throughput (retention collapse) fails.
+    res = gates.evaluate_gates(
+        {**vals, "scaling_efficiency": 0.5}, baseline
+    )
+    assert "scaling_efficiency" in res["failed"]
+    # A widening spread fails too.
+    res = gates.evaluate_gates(
+        {**vals, "data_wait_spread": 0.5}, baseline
+    )
+    assert "data_wait_spread" in res["failed"]
+
+
+@pytest.mark.slow
+def test_measure_scaling_sweeps_mesh_shapes():
+    """Real sweep over the suite's 8 virtual CPU devices (tiny windows —
+    the protocol, not the numbers, is under test)."""
+    from featurenet_tpu.benchmark import measure_scaling
+    from featurenet_tpu.config import get_config
+
+    sc = measure_scaling(get_config("smoke16"), batch_per_chip=4,
+                         repeats=2, shapes=[1, 2], min_window_sec=0.1)
+    assert set(sc["shapes"]) == {1, 2}
+    assert sc["scaling_efficiency"] > 0
+    for row in sc["shapes"].values():
+        assert row["samples_per_sec_per_chip"] > 0
+
+
+@pytest.mark.slow
+def test_measure_host_spread_probe_two_processes():
+    """The 2-process CPU probe behind the gate's data_wait_spread key."""
+    from featurenet_tpu.benchmark import measure_host_spread
+
+    row = measure_host_spread()
+    assert row["n_hosts"] == 2
+    assert 0.0 <= row["data_wait_spread"] <= 1.0

@@ -1,0 +1,413 @@
+"""Elastic multi-host training (featurenet_tpu.elastic).
+
+Three layers, cheapest first:
+
+1. Planner/membership units: world feasibility (global batch preserved),
+   slot selection, the atomic membership file.
+2. Coordinator state machine over FAKE children (``python -c`` scripts
+   coordinating through heartbeat files — no JAX, seconds per case):
+   loss → shrink, rejoin at the planned boundary, full-world loss →
+   restart at strength, deterministic startup failure → give up.
+3. The real thing (tier-1, CPU, 2 processes): ``host_loss`` injected
+   mid-run kills one host of a live 2-process mesh; the coordinator
+   re-forms at world size 1 from the latest checkpoint and the run
+   completes its full step budget — and a companion grow test re-admits
+   the lost host at the next generation boundary. Both assert the
+   ``mesh_reform`` timeline and that the global batch survived every
+   re-form.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+from featurenet_tpu.elastic import (
+    ElasticCoordinator,
+    Membership,
+    heartbeat_path,
+    read_membership,
+    write_membership,
+)
+from featurenet_tpu.elastic.planner import (
+    InfeasibleWorld,
+    feasible_world_sizes,
+    per_host_batch,
+    plan_world,
+)
+
+
+# --- planner -----------------------------------------------------------------
+
+def test_feasible_world_sizes_respect_global_batch():
+    # 8-sample global batch over 2-device hosts: 1, 2, or 4 hosts divide.
+    assert feasible_world_sizes(8, 2, 6) == [1, 2, 4]
+    assert feasible_world_sizes(96, 1, 5) == [1, 2, 3, 4]
+
+
+def test_plan_world_keeps_low_slots_and_preserves_global_batch():
+    # 3 survivors of a 4-host world, batch 8 over 2-device hosts: 3 hosts
+    # don't divide, so the plan drops to 2 — keeping the LOWEST slots
+    # (rank 0 owns the primary stream) — and the global batch is intact.
+    members = plan_world([0, 2, 3], min_world_size=1, global_batch=8,
+                         local_devices=2)
+    assert members == (0, 2)
+    assert per_host_batch(8, len(members)) == 4  # rescaled, not shrunk
+
+
+def test_plan_world_refuses_below_min_world_size():
+    with pytest.raises(InfeasibleWorld):
+        plan_world([0], min_world_size=2, global_batch=8, local_devices=2)
+    with pytest.raises(InfeasibleWorld):
+        # 3 survivors, batch 25, min 2: only a 1-host world divides.
+        plan_world([0, 1, 2], min_world_size=2, global_batch=25,
+                   local_devices=1)
+
+
+# --- membership file ---------------------------------------------------------
+
+def test_membership_roundtrip_and_torn_file_reads_none(tmp_path):
+    m = Membership(generation=3, members=(0, 2), min_world_size=1,
+                   reason="host_loss")
+    write_membership(str(tmp_path), m)
+    got = read_membership(str(tmp_path))
+    assert got == m and got.world_size == 2
+    # Garbage (something else wrote here) must read as unknown, not crash.
+    with open(tmp_path / "membership.json", "w") as fh:
+        fh.write('{"generation": 1, "mem')
+    assert read_membership(str(tmp_path)) is None
+    assert read_membership(str(tmp_path / "nope")) is None
+
+
+# --- coordinator over fake children ------------------------------------------
+
+def _beat_then(code: str, hb: str) -> list[str]:
+    """A fake child: prove liveness (touch the heartbeat strictly after
+    the coordinator's baseline), then run ``code``."""
+    return [sys.executable, "-c",
+            "import os, time\n"
+            f"hb = {hb!r}\n"
+            "time.sleep(0.25); open(hb, 'a').close(); os.utime(hb, None)\n"
+            "time.sleep(0.1)\n"
+            + code]
+
+
+def _coordinator(tmp_path, scenario, n_hosts=2, **kw):
+    """Coordinator whose children act out ``scenario``:
+    ``(generation, slot) -> python code`` (default: exit 0)."""
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir, exist_ok=True)
+
+    def spawn(members, rank, generation, port):
+        slot = members[rank]
+        code = scenario.get((generation, slot), "raise SystemExit(0)")
+        return _beat_then(code, heartbeat_path(run_dir, slot))
+
+    kw.setdefault("min_world_size", 1)
+    kw.setdefault("global_batch", 8)
+    kw.setdefault("local_devices", 2)
+    kw.setdefault("poll_s", 0.1)
+    kw.setdefault("grace_s", 30.0)
+    kw.setdefault("stall_timeout_s", 30.0)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("log", lambda _: None)
+    return ElasticCoordinator(n_hosts, spawn, run_dir, **kw), run_dir
+
+
+def _events(run_dir: str, kind=None) -> list[dict]:
+    out = []
+    with open(os.path.join(run_dir, "events.jsonl")) as fh:
+        for line in fh:
+            e = json.loads(line)
+            if kind is None or e.get("ev") == kind:
+                out.append(e)
+    return out
+
+
+_HANG = "import time; time.sleep(60)"
+
+
+def test_coordinator_shrinks_on_host_loss_and_survivor_finishes(tmp_path):
+    # Gen 0: slot 1 crashes after beating (slot 0 hangs in its
+    # "collective" and is killed as part of the re-form); gen 1: the
+    # survivor completes. One loss verdict, one shape change, exit 0.
+    coord, run_dir = _coordinator(tmp_path, {
+        (0, 0): _HANG,
+        (0, 1): "raise SystemExit(7)",
+    })
+    res = coord.run()
+    assert res.exit_code == 0
+    assert res.losses == 1 and res.rejoins == 0 and res.reforms == 1
+    assert res.generations == 2
+    reforms = [(e["from_n"], e["to_n"], e["reason"])
+               for e in _events(run_dir, "mesh_reform")]
+    assert reforms == [(0, 2, "start"), (2, 1, "host_loss")]
+    leaves = _events(run_dir, "host_leave")
+    assert len(leaves) == 1 and leaves[0]["host"] == 1
+    m = read_membership(run_dir)
+    assert m.generation == 1 and m.members == (0,) \
+        and m.reason == "host_loss"
+
+
+def test_coordinator_readmits_lost_host_at_planned_boundary(tmp_path):
+    # Gen 0: slot 1 lost. Gen 1: the survivor reaches a planned cut
+    # (exit 75) — the boundary where the recovered host rejoins. Gen 2:
+    # full strength again, both finish.
+    coord, run_dir = _coordinator(tmp_path, {
+        (0, 0): _HANG,
+        (0, 1): "raise SystemExit(9)",
+        (1, 0): "raise SystemExit(75)",
+    })
+    res = coord.run()
+    assert res.exit_code == 0
+    assert res.losses == 1 and res.planned == 1 and res.rejoins == 1
+    reforms = [(e["from_n"], e["to_n"], e["reason"])
+               for e in _events(run_dir, "mesh_reform")]
+    assert reforms == [(0, 2, "start"), (2, 1, "host_loss"),
+                       (1, 2, "host_rejoin")]
+    joins = _events(run_dir, "host_join")
+    assert len(joins) == 1 and joins[0]["host"] == 1 \
+        and joins[0]["generation"] == 2
+    m = read_membership(run_dir)
+    assert m.generation == 2 and m.members == (0, 1)
+
+
+def test_coordinator_full_world_loss_restarts_at_strength(tmp_path):
+    # min_world_size=2: losing a host leaves no admissible shrink, so the
+    # coordinator re-admits everything and restarts the full world (the
+    # plain supervisor's move) instead of giving up.
+    coord, run_dir = _coordinator(tmp_path, {
+        (0, 0): _HANG,
+        (0, 1): "raise SystemExit(5)",
+    }, min_world_size=2)
+    res = coord.run()
+    assert res.exit_code == 0
+    assert res.losses == 1 and res.rejoins == 1
+    m = read_membership(run_dir)
+    assert m.generation == 1 and m.members == (0, 1) \
+        and m.reason == "restart"
+
+
+def test_coordinator_gives_up_on_deterministic_startup_failure(tmp_path):
+    # No child ever beats: a config error, not a host dying under load —
+    # two attempts, then the give-up verdict with the child's exit code
+    # (shrinking would misdiagnose it and burn the world to nothing).
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir, exist_ok=True)
+    coord = ElasticCoordinator(
+        2, lambda members, rank, generation, port:
+            [sys.executable, "-c", "raise SystemExit(3)"],
+        run_dir, global_batch=8, local_devices=2, poll_s=0.1,
+        grace_s=30.0, backoff_base_s=0.05, log=lambda _: None,
+    )
+    res = coord.run()
+    assert res.exit_code == 3
+    assert res.generations == 2 and res.losses == 0
+    phases = [e["phase"] for e in _events(run_dir, "supervisor")]
+    assert phases.count("giving_up") == 1
+
+
+# --- the real thing: a live 2-process CPU mesh -------------------------------
+
+# The elastic training child: rank/world/port/generation/slot from the
+# coordinator, config overrides as JSON. Forces 2 CPU devices per
+# process and joins the generation's explicit jax.distributed world.
+# Generation 0 uses the suite's persistent compile cache (fresh runs
+# load/store safely — test_multihost's sync workers do the same); later
+# generations RESUME, and a resumed segment executing a deserialized
+# executable can fatally abort in this sandbox (see test_multihost.py),
+# so they compile fresh.
+_WORKER = r"""
+import json, os, sys
+rank, world, port, gen, slot = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+    int(sys.argv[5]),
+)
+over = json.loads(sys.argv[6])
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+if world > 1:
+    # gloo needs the distributed client; a world-of-one generation has
+    # none (and the flag would break CPU backend init outright).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass
+if gen == 0:
+    repo = os.environ["PYTHONPATH"].split(os.pathsep)[0]
+    cache = os.path.join(repo, ".cache", "jax_compile")
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+else:
+    jax.config.update("jax_enable_compilation_cache", False)
+if world > 1:
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=world, process_id=rank,
+    )
+from featurenet_tpu.config import get_config
+from featurenet_tpu.train.loop import Trainer
+Trainer(get_config("smoke16", **over)).run()
+"""
+
+
+def _elastic_run(tmp_path, inject: str, extra: dict | None = None):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir, exist_ok=True)
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    }
+
+    def spawn(members, rank, generation, port):
+        slot = members[rank]
+        over = dict(
+            total_steps=4,
+            global_batch=8,
+            data_workers=1,
+            eval_batches=1,
+            log_every=10**9,
+            eval_every=10**9,
+            checkpoint_every=1,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            run_dir=run_dir,
+            heartbeat_file=heartbeat_path(run_dir, slot),
+            inject_faults=inject,
+        )
+        over.update(extra or {})
+        return [sys.executable, "-c", _WORKER, str(rank),
+                str(len(members)), str(port), str(generation), str(slot),
+                json.dumps(over)]
+
+    coord = ElasticCoordinator(
+        2, spawn, run_dir,
+        min_world_size=1, global_batch=8, local_devices=2,
+        stall_timeout_s=120.0, grace_s=600.0, poll_s=0.2,
+        max_reforms=3, backoff_base_s=0.05, env=env, log=lambda _: None,
+    )
+    return coord.run(), run_dir
+
+
+def _merged(run_dir):
+    from featurenet_tpu.obs.report import build_report, load_events
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    return events, build_report(events)
+
+
+def test_elastic_e2e_host_loss_shrinks_to_one_and_completes(tmp_path):
+    """The tentpole e2e: one host of a live 2-process mesh is SIGKILLed
+    mid-run (``host_loss`` at step 3, after the step-2 checkpoint); the
+    coordinator re-forms at world size 1 from the latest checkpoint and
+    the run completes its full 4-step budget with no intervention. The
+    merged report carries the ``mesh_reform`` timeline, both hosts'
+    streams (with the dead host's truncation attributed in the skew
+    section), and a preserved global batch at both mesh shapes."""
+    res, run_dir = _elastic_run(tmp_path, "host_loss@step=3")
+    assert res.exit_code == 0
+    assert res.losses == 1 and res.rejoins == 0 and res.generations == 2
+
+    events, rep = _merged(run_dir)
+    reforms = [(e["from_n"], e["to_n"], e["reason"])
+               for e in events if e["ev"] == "mesh_reform"]
+    assert reforms == [(0, 2, "start"), (2, 1, "host_loss")]
+    assert sum(1 for e in events if e["ev"] == "host_leave") == 1
+    # Full budget reached in the re-formed world.
+    assert any(e["ev"] == "run_end" and e["step"] == 4 for e in events)
+    # Global batch preserved across the re-form: every generation's loop
+    # ran the same global batch, at different world shapes.
+    starts = [e for e in events if e["ev"] == "loop_start"]
+    assert {e["global_batch"] for e in starts} == {8}
+    assert {e["mesh"]["processes"] for e in starts} == {1, 2}
+    # Resumed from the latest checkpoint, not from scratch: the second
+    # generation's loop starts past step 0.
+    assert max(e["step"] for e in starts) >= 2
+    # Report: the recovery section shows the re-form timeline, and both
+    # hosts' streams merged with the dead host's truncation attributed.
+    assert rep["recovery"]["mesh_reforms"] == 2
+    assert rep["recovery"]["host_leaves"] == 1
+    assert sorted(rep["hosts"]) == [0, 1]
+    assert rep["host_skew"].get("step_mismatch")  # host 1 fell out
+    # The scaling gate's cross-host scalar exists on this run's report.
+    from featurenet_tpu.obs.gates import report_gate_values
+
+    assert "data_wait_spread" in report_gate_values(rep)
+    m = read_membership(run_dir)
+    assert m.world_size == 1 and m.reason == "host_loss"
+
+
+def test_elastic_e2e_grow_readmits_host_at_generation_boundary(tmp_path):
+    """The companion grow path: after the loss, the shrunken world hits a
+    planned segment cut (``restart_every_steps``) and the recovered host
+    is re-admitted there — generation 2 trains at full strength again
+    and finishes the budget."""
+    res, run_dir = _elastic_run(
+        tmp_path, "host_loss@step=1", extra={"restart_every_steps": 2},
+    )
+    assert res.exit_code == 0
+    assert res.losses == 1 and res.rejoins == 1 and res.planned >= 1
+
+    events, rep = _merged(run_dir)
+    reasons = [e["reason"] for e in events if e["ev"] == "mesh_reform"]
+    assert reasons == ["start", "host_loss", "host_rejoin"]
+    grown = [e for e in events if e["ev"] == "mesh_reform"][-1]
+    assert grown["to_n"] == 2
+    joins = [e for e in events if e["ev"] == "host_join"]
+    assert len(joins) == 1 and joins[0]["generation"] >= 2
+    assert any(e["ev"] == "run_end" and e["step"] == 4 for e in events)
+    starts = [e for e in events if e["ev"] == "loop_start"]
+    assert {e["global_batch"] for e in starts} == {8}
+    m = read_membership(run_dir)
+    assert m.world_size == 2 and m.reason == "host_rejoin"
+    assert rep["recovery"]["host_joins"] == 1
+
+
+# --- CLI wiring (parse-time refusals; no backend, no processes) --------------
+
+def test_cli_elastic_requires_checkpoint_and_run_dir(tmp_path):
+    from featurenet_tpu import cli
+
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        cli.main(["train", "--config", "smoke16", "--elastic",
+                  "--world-size", "2"])
+    with pytest.raises(SystemExit, match="run-dir"):
+        cli.main(["train", "--config", "smoke16", "--elastic",
+                  "--world-size", "2",
+                  "--checkpoint-dir", str(tmp_path / "ck")])
+    with pytest.raises(SystemExit, match="drop --supervise"):
+        cli.main(["train", "--config", "smoke16", "--elastic",
+                  "--supervise",
+                  "--checkpoint-dir", str(tmp_path / "ck"),
+                  "--run-dir", str(tmp_path / "run")])
+    # An undividable full-strength world is refused up front: plan_world
+    # would otherwise silently form generation 0 BELOW the requested
+    # world size (it keeps the largest feasible world).
+    with pytest.raises(SystemExit, match="not.*divisible"):
+        cli.main(["train", "--config", "smoke16", "--elastic",
+                  "--world-size", "3", "--local-devices", "1",
+                  "--global-batch", "8",
+                  "--checkpoint-dir", str(tmp_path / "ck"),
+                  "--run-dir", str(tmp_path / "run")])
+
+
+def test_config_min_world_size_guards():
+    import dataclasses
+
+    from featurenet_tpu.config import get_config
+
+    with pytest.raises(ValueError, match="min_world_size"):
+        get_config("smoke16", min_world_size=0)
+    with pytest.raises(ValueError, match="elastic"):
+        get_config("smoke16", min_world_size=2)
+    cfg = get_config("smoke16", elastic=True, min_world_size=2)
+    assert dataclasses.asdict(cfg)["min_world_size"] == 2

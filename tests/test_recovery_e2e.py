@@ -236,3 +236,38 @@ def test_e2e_sink_enospc_training_survives(tmp_path, capsys):
     events = _events(tmp_path)  # every line before the fault parses clean
     assert len(events) == 11  # emits 1..11 landed; #12 died; then dark
     assert not any(e["ev"] == "run_end" for e in events)  # post-fault
+
+
+# --- soak: repeatable preemption through many cycles --------------------------
+
+def test_e2e_soak_repeatable_sigterm_three_cycles(tmp_path):
+    """Soak e2e (carried-over ROADMAP follow-on): a repeatable SIGTERM
+    (``sigterm@step=1:every=1``) preempts the run at EVERY step; one
+    supervised run must ride >= 3 preempt/resume cycles — each a drained
+    checkpoint + free planned respawn — and still reach its full step
+    budget. Gated on the report's recovery section: cycle count, zero
+    unplanned restarts/stalls, terminal run_end at the budget."""
+    res, records = _supervised(tmp_path, "sigterm@step=1:every=1",
+                               total_steps=4, max_restarts=2)
+    assert res.exit_code == 0
+    assert res.planned == 3      # three preempt/resume cycles...
+    assert res.restarts == 0     # ...none of them on the failure budget
+    events = _events(tmp_path)
+    from featurenet_tpu.obs.report import build_report
+
+    rep = build_report(events)
+    assert rep["recovery"]["preempts"] == 3
+    assert rep["supervisor"]["planned_restarts"] == 3
+    assert rep["supervisor"]["restarts"] == 0
+    assert rep["supervisor"]["stalls"] == 0
+    assert any(e["ev"] == "run_end" and e["step"] == 4 for e in events)
+    # The same verdict through the gate machinery: pin "no unplanned
+    # recovery activity" and judge the soak's own report against it.
+    from featurenet_tpu.obs import gates as obs_gates
+
+    gate = obs_gates.evaluate_gates(
+        obs_gates.report_gate_values(rep),
+        obs_gates.make_baseline({"restarts": 0.0, "stalls": 0.0},
+                                tolerance=0.0),
+    )
+    assert gate["ok"], gate
