@@ -64,7 +64,16 @@ def telemetry_rule(tree: Tree) -> list[Finding]:
     ``name``/``msg`` as their leading positionals. Kinds with no emit site
     anywhere are dead schema: either the event was removed without its
     declaration, or the declaration was added without its producer.
+
+    Rolling-window feed sites are under the same contract:
+    ``observe(<literal>, ...)`` through the obs layer must name a metric
+    in ``alerts.WINDOW_METRICS`` — the aggregator silently ignores
+    unknown metrics by design (instrumentation must never crash), so a
+    typo'd name is a window that never fills and an SLO/perf metric that
+    silently watches nothing (the perf layer's ``mfu`` /
+    ``achieved_bw_fraction`` feeds ride this check).
     """
+    from featurenet_tpu.obs.alerts import WINDOW_METRICS
     from featurenet_tpu.obs.report import (
         KNOWN_EVENT_KINDS,
         REQUIRED_EVENT_FIELDS,
@@ -77,6 +86,24 @@ def telemetry_rule(tree: Tree) -> list[Finding]:
             if not isinstance(node, ast.Call):
                 continue
             name = _call_name(node)
+            if name == "observe":
+                # Only the obs layer's window feed: bare observe()
+                # (imported from obs), obs.observe, or the windows
+                # module's own entry point — a foreign .observe() API
+                # is not under this contract.
+                if _call_owner(node) not in (None, "obs", "windows",
+                                             "_windows"):
+                    continue
+                metric = _str_arg(node)
+                if metric is not None and metric not in WINDOW_METRICS:
+                    findings.append(Finding(
+                        "telemetry", "unknown_window_metric", mod.path,
+                        node.lineno,
+                        f"observe of unknown window metric {metric!r} — "
+                        "the aggregator would silently drop every sample; "
+                        "add it to alerts.WINDOW_METRICS or fix the typo",
+                    ))
+                continue
             if name == "warn":
                 # Only the obs layer's warn is under this contract: bare
                 # ``warn(...)`` (imported from obs) or ``obs.warn(...)``.

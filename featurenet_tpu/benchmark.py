@@ -168,7 +168,7 @@ def measure_train_step(
     per_step = conv["per_call"]
     sps_chip = global_batch / per_step / n_chips
     fps = train_step_flops_per_sample(cfg.arch, R)
-    return {
+    out = {
         "batch_per_chip": batch_per_chip,
         "per_step_ms": round(per_step * 1e3, 2),
         "samples_per_sec_per_chip": round(sps_chip, 2),
@@ -180,6 +180,26 @@ def measure_train_step(
         "mfu": round(mfu(sps_chip, fps), 3),
         "mfu_peak_tflops": PEAK_BF16_FLOPS / 1e12,
     }
+    # Measured-cost attribution (obs.perf): MFU from the COMPILED
+    # program's own XLA flop count (per-device, post-partitioning) over
+    # the slope-timed step wall and the device-kind peak table — the
+    # evidence-based counterpart of the analytic `mfu` above — plus the
+    # executable's peak-memory footprint. Honest-absence on backends
+    # with no cost analysis / no peak entry (CPU): the keys stay out,
+    # and so do their gate pins.
+    from featurenet_tpu.obs import perf as obs_perf
+
+    peaks = obs_perf.local_device_peaks()
+    cost = getattr(step, "cost", None) or {}
+    m = obs_perf.mfu_value(cost, per_step, peaks)
+    if m is not None:
+        out["mfu_train"] = round(m, 4)
+    if cost.get("peak_bytes"):
+        out["hbm_peak_train_bytes"] = int(cost["peak_bytes"])
+    roof = obs_perf.roofline(cost.get("flops"), cost.get("bytes"), peaks)
+    if roof is not None:
+        out["train_roofline"] = roof
+    return out
 
 
 def measure_ttfs(cfg, batch_per_chip: int = 256,
@@ -432,6 +452,14 @@ def measure_inference(
     # draw until the two best agree, quote their mean.
     conv = _converged_slope(walled, measure, repeats)
     per_batch = conv["per_call"]
+    # Serving-side measured-cost MFU (obs.perf), same shape as
+    # measure_train_step's mfu_train: compiled flops over the converged
+    # per-batch wall over the peak table; absent when either is unknown.
+    from featurenet_tpu.obs import perf as obs_perf
+
+    peaks = obs_perf.local_device_peaks()
+    m = obs_perf.mfu_value(getattr(program, "cost", None), per_batch, peaks)
+    perf_fields = {} if m is None else {"serve_mfu": round(m, 4)}
     return {
         "batch_per_chip": batch_per_chip,
         "precision": precision,
@@ -439,6 +467,7 @@ def measure_inference(
         "inferences_per_sec_per_chip": round(
             global_batch / per_batch / n_chips, 1
         ),
+        **perf_fields,
         "repeats": conv["draws"],
         # spread_pct: agreement between the two best slopes — the
         # reproducibility of the quoted number. spread_minmax_pct: full

@@ -141,6 +141,14 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "serving_p99_ms>20'; default: the built-in rule "
                         "set (data-wait fraction, step p99/median ratio, "
                         "heartbeat age, cross-host data-wait spread)")
+    p.add_argument("--poll-device-memory", action="store_true",
+                   dest="poll_device_memory",
+                   help="sample per-device memory_stats() at each "
+                        "heartbeat (off the hot path) into device_memory "
+                        "events — the report's HBM watermark and a "
+                        "Chrome-trace counter track (featurenet_tpu.obs."
+                        "perf); needs --run-dir, degrades silently on "
+                        "backends without stats")
 
 
 def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
@@ -223,6 +231,8 @@ def _overrides(args) -> dict:
         out["augment"] = False
     if getattr(args, "hbm_cache", False):
         out["hbm_cache"] = True
+    if getattr(args, "poll_device_memory", False):
+        out["poll_device_memory"] = True
     if getattr(args, "elastic", False):
         out["elastic"] = True
     if getattr(args, "augment_affine", False):
@@ -287,6 +297,9 @@ def _cfg_from_checkpoint(saved, args):
     for k in ("heartbeat_file", "profile_dir", "tb_dir", "run_dir",
               "restart_every_steps", "inject_faults", "exec_cache_dir"):
         over.setdefault(k, None)
+    # Same ephemerality, bool-typed: the memory poller belongs to the run
+    # that asked for it, not to every later eval/resume of its checkpoint.
+    over.setdefault("poll_device_memory", False)
     # Arch flags must reach the returned config too — check_identity above
     # already rejected real contradictions, so what flows through here is
     # exactly the deliberately-allowed lowering choice (conv_backend A/B

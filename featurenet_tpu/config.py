@@ -213,6 +213,14 @@ class Config:
     # serialization, no deserialization, anywhere. The
     # FEATURENET_EXEC_CACHE_DIR env var supplies a fleet-wide default.
     exec_cache_dir: Optional[str] = None
+    # Live device-memory watermark (featurenet_tpu.obs.perf): when on,
+    # the Trainer samples jax.local_devices()[i].memory_stats() at every
+    # heartbeat — off the dispatch hot path by construction — and emits
+    # device_memory events (the report's watermark line and a Chrome-
+    # trace counter track). Opt-in because it is extra per-beat work;
+    # backends without stats (CPU) degrade silently to no events. Only
+    # meaningful with run_dir (no sink, no events).
+    poll_device_memory: bool = False
     # Liveness: when set, the Trainer touches this file at every confirmed
     # point of progress (a device readback, an eval, a checkpoint). A
     # supervisor (train.supervisor / `cli train --supervise`) watches the

@@ -58,11 +58,12 @@ from typing import Callable, Optional, Sequence
 from featurenet_tpu import faults
 from featurenet_tpu.elastic.membership import Membership, write_membership
 from featurenet_tpu.elastic.planner import InfeasibleWorld, plan_world
-from featurenet_tpu.train.supervisor import (
-    RESTART_EXIT_CODE,
-    _kill_tree,
-    touch_heartbeat,
-)
+# One heartbeat/stall state machine for both watchers: the coordinator
+# drives one HeartbeatMonitor per slot, the plain supervisor drives one
+# for its single child — the duplicated fresh-baseline/grace/re-read
+# logic lives only in train.heartbeat now.
+from featurenet_tpu.train.heartbeat import HeartbeatMonitor
+from featurenet_tpu.train.supervisor import RESTART_EXIT_CODE, _kill_tree
 
 
 def heartbeat_path(run_dir: str, slot: int) -> str:
@@ -171,13 +172,18 @@ class ElasticCoordinator:
 
     def _run_generation(self, members: Sequence[int], generation: int,
                         port: int, record) -> _GenOutcome:
-        hbs = {slot: heartbeat_path(self.run_dir, slot) for slot in members}
-        base: dict[int, float] = {}
-        for slot, hb in hbs.items():
-            # Fresh baseline per spawn: only a NEWER mtime proves this
-            # generation's child beat (the supervisor's protocol).
-            touch_heartbeat(hb)
-            base[slot] = os.path.getmtime(hb)
+        # One shared heartbeat monitor per slot (train.heartbeat): reset
+        # gives each spawn a fresh baseline — only a NEWER mtime proves
+        # this generation's child beat (the supervisor's protocol).
+        mons = {
+            slot: HeartbeatMonitor(
+                heartbeat_path(self.run_dir, slot),
+                self.stall_timeout_s, self.grace_s,
+            )
+            for slot in members
+        }
+        for mon in mons.values():
+            mon.reset()
         procs: dict[int, subprocess.Popen] = {}
         for rank, slot in enumerate(members):
             self._spawns += 1
@@ -193,8 +199,6 @@ class ElasticCoordinator:
             }))
             record("spawn", host=slot, rank=rank, generation=generation,
                    pid=procs[slot].pid)
-        started = time.monotonic()
-        beats: set[int] = set()
         self_exits: dict[int, int] = {}
         stalled: Optional[int] = None
         first_crash: Optional[int] = None
@@ -232,32 +236,12 @@ class ElasticCoordinator:
             for slot in members:
                 if slot in self_exits:
                     continue
-                try:
-                    mtime = os.path.getmtime(hbs[slot])
-                except OSError:
-                    # Deleted externally: recreate (a dead coordinator
-                    # orphans the whole generation) and restart the clock.
-                    touch_heartbeat(hbs[slot])
-                    mtime = base[slot] = os.path.getmtime(hbs[slot])
-                if mtime > base[slot]:
-                    beats.add(slot)
-                # lint: allow-wall-clock(file mtimes are epoch-based)
-                age = time.time() - mtime
-                if slot not in beats:
-                    if time.monotonic() - started > self.grace_s:
-                        stalled = slot
-                elif age > self.stall_timeout_s:
-                    # Re-read before the verdict: a beat can land between
-                    # the sample above and here, and a SIGKILL on a live
-                    # mesh costs a whole-generation restart for nothing.
-                    try:
-                        # lint: allow-wall-clock(file mtimes are epoch-based)
-                        age = time.time() - os.path.getmtime(hbs[slot])
-                    except OSError:
-                        pass
-                    if age > self.stall_timeout_s:
-                        stalled = slot
-                if stalled is not None:
+                # Deleted-file recreate, first-beat-vs-grace, and the
+                # re-read-before-verdict double check all live in the
+                # shared monitor (a SIGKILL on a live mesh costs a
+                # whole-generation restart for nothing).
+                if mons[slot].poll() == "stall":
+                    stalled = slot
                     break
             if stalled is not None:
                 self.log(json.dumps({
@@ -284,12 +268,9 @@ class ElasticCoordinator:
                 time.sleep(0.02)
         # Final beat sweep (a beat may have landed inside the last poll
         # window) BEFORE the kills below can freeze the mtimes.
-        for slot in members:
-            try:
-                if os.path.getmtime(hbs[slot]) > base[slot]:
-                    beats.add(slot)
-            except OSError:
-                pass
+        for mon in mons.values():
+            mon.recheck()
+        beats = {slot for slot, mon in mons.items() if mon.beaten}
         exits = dict(self_exits)
         if first_crash is not None or stalled is not None:
             survivors_killed = 0

@@ -109,6 +109,12 @@ class Predictor:
         # (featurenet_tpu.serve) warms one per bucket in its ladder.
         self._programs: dict[int, object] = {}
         self._program = self.program_for(batch)
+        # Perf attribution (obs.perf): serving-side MFU folds each
+        # batch's measured wall against the program's compiled counters
+        # (explicit `unknown` tier — no samples — on CPU).
+        from featurenet_tpu.obs import perf as _perf
+
+        self._peaks = _perf.local_device_peaks()
 
     def program_for(self, batch: int):
         """The ``serve``/``serve_int8`` executable at this compile batch,
@@ -277,6 +283,10 @@ class Predictor:
 
     def _batched_forward(self, g: np.ndarray) -> np.ndarray:
         """Chunk/pad ``g`` to the static compile batch, run, trim, concat."""
+        import time as _time
+
+        from featurenet_tpu.obs import perf as _perf
+
         out = []
         for s in range(0, g.shape[0], self.batch):
             chunk = g[s : s + self.batch]
@@ -288,10 +298,16 @@ class Predictor:
             # Serving latency span: np.asarray forces the readback, so the
             # measured interval is true request latency (dispatch + device
             # + transfer), feeding the report's latency histogram.
+            t0 = _time.perf_counter()
             with obs.span("infer_batch", n=self.batch - pad,
                           batch=self.batch):
                 # lint: allow-host-sync(readback IS the measured latency)
                 y = np.asarray(self._forward(chunk))
+            # Same wall the span measured, folded into the rolling MFU.
+            _perf.observe_dispatch(
+                getattr(self._program, "cost", None),
+                _time.perf_counter() - t0, peaks=self._peaks,
+            )
             out.append(y[: self.batch - pad])
         return np.concatenate(out, axis=0)
 

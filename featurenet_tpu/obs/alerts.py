@@ -61,6 +61,11 @@ WINDOW_METRICS = (
                         # enqueue→response interval per request)
     "queue_wait_ms",    # serving front end: request enqueue→dispatch wait
                         # (admission pressure building before latency blows)
+    "mfu",              # per-dispatch model-flops utilization (obs.perf:
+                        # compiled flops over wall over the device-kind
+                        # peak; no samples on the `unknown` peak tier)
+    "achieved_bw_fraction",  # per-dispatch bytes-accessed over wall over
+                        # the device-kind peak HBM bandwidth (obs.perf)
 )
 
 _WINDOW_STATS = ("p50", "p95", "p99", "max", "mean")
@@ -73,6 +78,8 @@ DERIVED_METRICS = (
     "queue_depth",          # p50 of the depth window (starvation reads low)
     "serving_p99_ms",       # p99 of the serving window
     "data_wait_spread",     # cross-host; report-scope only (see module doc)
+    "mfu",                  # p50 of the mfu window (regression reads LOW:
+                            # rules use `<`, e.g. mfu<0.3:warning)
 )
 
 REPORT_SCOPE_METRICS = frozenset({"data_wait_spread"})

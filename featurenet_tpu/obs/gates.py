@@ -63,6 +63,14 @@ DIRECTIONS = {
     "value": "min",
     "serving_inferences_per_sec_per_chip": "min",
     "mfu": "min",
+    # Performance attribution (obs.perf): measured-cost MFU of the train
+    # step and the serving program regress DOWNWARD like throughput —
+    # they ARE throughput, restated against the device peak; the train
+    # step's compiled peak-memory footprint regresses UPWARD (growing
+    # HBM pressure eats the headroom the remaining speed rungs need).
+    "mfu_train": "min",
+    "serve_mfu": "min",
+    "hbm_peak_train_bytes": "max",
     "e2e_samples_per_sec": "min",
     "e2e_pipelined_samples_per_sec": "min",
     "e2e_hbm_samples_per_sec": "min",
@@ -131,6 +139,24 @@ def report_gate_values(rep: dict) -> dict[str, float]:
     dwf = (rep.get("host_skew") or {}).get("data_wait_fraction")
     if dwf and dwf.get("spread") is not None:
         vals["data_wait_spread"] = dwf["spread"]
+    # Performance attribution (obs.perf): the rolling MFU's p50 and the
+    # train programs' compiled peak-memory footprint are gateable like
+    # any throughput/latency scalar — an MFU regression fails --gate
+    # exactly like a samples/sec regression. Both honest-absence: a CPU
+    # run (unknown peak tier) records no mfu window, a degraded cost
+    # capture no peak_bytes, and the keys simply stay out.
+    perf = rep.get("perf") or {}
+    mfu_row = perf.get("mfu")
+    if isinstance(mfu_row, dict) and mfu_row.get("p50") is not None:
+        vals["mfu"] = float(mfu_row["p50"])
+    train_peaks = [
+        row["peak_bytes"]
+        for name, row in (perf.get("programs") or {}).items()
+        if name in ("train_step", "multi_train_step", "hbm_train_step")
+        and isinstance(row.get("peak_bytes"), (int, float))
+    ]
+    if train_peaks:
+        vals["hbm_peak_train_bytes"] = float(max(train_peaks))
     vals["bad_lines"] = float(rep.get("bad_lines", 0))
     return vals
 
@@ -155,6 +181,9 @@ BENCH_GATE_KEYS = (
     "serving_int8_spread_pct",
     "ttfs_cold_s",
     "ttfs_warm_s",
+    "mfu_train",
+    "serve_mfu",
+    "hbm_peak_train_bytes",
     "window_data_wait_p50_ms",
     "window_data_wait_p99_ms",
     "window_queue_depth_p50",

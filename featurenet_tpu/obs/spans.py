@@ -107,13 +107,18 @@ def chrome_trace(events: list[dict]) -> dict:
 
     ``window_summary`` events export as counter ("ph":"C") tracks — one
     per metric — so the rolling p50/p95/p99 render as stepped series
-    above the span lanes they summarize."""
+    above the span lanes they summarize (the ``mfu`` window rides this
+    path: an MFU counter track for free). ``device_memory`` events (the
+    obs.perf heartbeat-cadence poller) export as one counter track per
+    device — the HBM watermark next to the spans that caused it."""
     spans = [e for e in events if e.get("ev") == "span" and "dur_s" in e]
     windows = [e for e in events
                if e.get("ev") == "window_summary" and "metric" in e]
-    if not spans and not windows:
+    mem = [e for e in events
+           if e.get("ev") == "device_memory" and "bytes_in_use" in e]
+    if not spans and not windows and not mem:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    t0 = min(e["t"] for e in spans + windows)
+    t0 = min(e["t"] for e in spans + windows + mem)
     track_ids: dict[tuple, int] = {}
 
     def track(e: dict) -> int:
@@ -141,6 +146,17 @@ def chrome_trace(events: list[dict]) -> dict:
             "pid": track(e),
             "args": {
                 k: e[k] for k in ("p50", "p95", "p99")
+                if isinstance(e.get(k), (int, float))
+            },
+        })
+    for e in mem:
+        out.append({
+            "name": f"device {e.get('device', 0)} memory",
+            "ph": "C",
+            "ts": (e["t"] - t0) * 1e6,
+            "pid": track(e),
+            "args": {
+                k: e[k] for k in ("bytes_in_use", "peak_bytes_in_use")
                 if isinstance(e.get(k), (int, float))
             },
         })

@@ -4,7 +4,8 @@ The report (``obs.report``) judges a run after the fact; production
 degradation has to be seen *while it happens*. This module maintains
 in-process ring buffers — the last N samples / T seconds — of the step
 loop's health signals (``alerts.WINDOW_METRICS``: step time, data-wait,
-prefetch queue depth, heartbeat age, serving latency), computes their
+prefetch queue depth, heartbeat age, serving latency, and the perf
+layer's per-dispatch MFU / achieved-bandwidth fractions), computes their
 p50/p95/p99 online, and periodically emits one ``window_summary`` event
 per metric. Every sample is a host-side float the instrumentation
 already had in hand (a span's ``perf_counter`` duration, a queue length)
@@ -201,6 +202,10 @@ class WindowAggregator:
             return _pct(sorted(self._win["queue_depth"].values(now)), 50)
         if metric == "serving_p99_ms":
             return _pct(sorted(self._win["serving_ms"].values(now)), 99)
+        if metric == "mfu":
+            # Median, not max: one lucky fused dispatch must not resolve
+            # a sustained-utilization alert.
+            return _pct(sorted(self._win["mfu"].values(now)), 50)
         base, _, stat = metric.rpartition("_")
         win = self._win.get(base)
         if win is not None and stat in ("p50", "p95", "p99", "max", "mean"):

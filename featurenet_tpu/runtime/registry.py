@@ -22,7 +22,10 @@ it ``build → lower → compile`` with:
   ``cache_reject`` event — never a crash.
 - **Observability**: ``program_compile`` / ``cache_hit`` / ``cache_miss``
   / ``cache_reject`` events make time-to-first-step attributable from the
-  run log alone (bench pins cold vs warm TTFS in its gate summary).
+  run log alone (bench pins cold vs warm TTFS in its gate summary), and
+  every build emits a ``program_cost`` event (``obs.perf``) carrying the
+  executable's XLA cost/memory counters — the report's per-program
+  flops/peak-HBM/roofline table and the rolling MFU metrics read from it.
 - **An int8 serving path** (``runtime.quantize``): ``serve_int8`` /
   ``serve_packed_int8`` run the same forward over per-channel-quantized
   int8 weights, dequantized on device — the serving throughput rung of
@@ -166,6 +169,11 @@ class CompiledProgram:
     compiled: Any  # jax.stages.Compiled
     source: str    # "fresh" (XLA compiled it now) or "cache" (deserialized)
     build_s: float
+    # Compiled cost/memory counters (obs.perf.program_cost): flops, bytes
+    # accessed, peak_bytes, … — whatever the backend could say, possibly
+    # empty. The train loop and the serving layer fold measured wall
+    # times against these into the rolling MFU/bandwidth metrics.
+    cost: dict = dataclasses.field(default_factory=dict)
 
     @property
     def name(self) -> str:
@@ -674,8 +682,17 @@ class Runtime:
             )
             if self.cache is not None:
                 self.cache.store(spec.name, fp, digest, compiled, spec.meta)
+        # Performance attribution (obs.perf): capture the executable's
+        # cost/memory analyses and emit the program_cost event — cache
+        # hits included (a deserialized executable's counters are the
+        # same program's). Guarded capture: a backend that cannot answer
+        # yields an honestly partial (possibly empty) cost dict.
+        from featurenet_tpu.obs import perf as _perf
+
+        cost = _perf.emit_program_cost(spec.name, compiled)
         return CompiledProgram(
-            spec, compiled, source, round(time.perf_counter() - t0, 3)
+            spec, compiled, source, round(time.perf_counter() - t0, 3),
+            cost,
         )
 
     def _compile(self, lowered):

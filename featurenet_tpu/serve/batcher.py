@@ -143,13 +143,23 @@ class ContinuousBatcher:
 
     def __init__(self, forward: Callable, buckets: Sequence[int] = DEFAULT_BUCKETS,
                  max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
-                 queue_limit: int = DEFAULT_QUEUE_LIMIT):
+                 queue_limit: int = DEFAULT_QUEUE_LIMIT,
+                 cost_for: Optional[Callable] = None,
+                 peaks: Optional[dict] = None):
         bs = normalize_buckets(buckets)
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         self.forward = forward
+        # Performance attribution (obs.perf), injected to keep the batcher
+        # backend-free: ``cost_for(bucket)`` returns that bucket's
+        # compiled cost counters (or None) and ``peaks`` the device-kind
+        # peak row; each dispatch then folds its measured wall into the
+        # rolling mfu / achieved_bw_fraction windows. Both default off —
+        # a bare-CPU test with a fake forward observes nothing.
+        self.cost_for = cost_for
+        self.peaks = peaks
         self.buckets = bs
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.queue_limit = int(queue_limit)
@@ -255,6 +265,15 @@ class ContinuousBatcher:
         except Exception as e:  # resolve the batch; the batcher survives
             err = e
         t_done = time.perf_counter()
+        if err is None and self.cost_for is not None:
+            from featurenet_tpu.obs import perf as _perf
+
+            # The dispatch wall here spans forward + readback (the
+            # service's forward returns a host array), so the MFU sample
+            # is the served batch's honest wall, not an enqueue time.
+            _perf.observe_dispatch(
+                self.cost_for(bucket), t_done - t_disp, peaks=self.peaks
+            )
         for i, p in enumerate(batch):
             if err is not None:
                 p.error = err

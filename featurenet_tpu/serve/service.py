@@ -91,18 +91,24 @@ class InferenceService:
         # registry (memoized in Predictor._programs, which _forward
         # re-resolves per dispatch). This loop is the whole reason no
         # request ever sees a compile — every shape the batcher can
-        # dispatch exists now.
-        for b in self.buckets:
-            predictor.program_for(b)
+        # dispatch exists now. The per-bucket cost counters captured at
+        # build feed the batcher's MFU fold (obs.perf) below.
+        costs = {
+            b: getattr(predictor.program_for(b), "cost", None)
+            for b in self.buckets
+        }
         if rules is None:
             rules = serve_rules(slo_p99_ms)
         if rules:
             _windows.install(_windows.WindowAggregator(
                 rules=list(rules), emit_every_s=emit_every_s
             ))
+        from featurenet_tpu.obs import perf as _perf
+
         self.batcher = ContinuousBatcher(
             self._forward, buckets=self.buckets, max_wait_ms=max_wait_ms,
             queue_limit=queue_limit,
+            cost_for=costs.get, peaks=_perf.local_device_peaks(),
         )
         obs.emit("serve_start", buckets=list(self.buckets),
                  max_wait_ms=float(max_wait_ms), queue_limit=int(queue_limit))

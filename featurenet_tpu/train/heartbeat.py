@@ -1,0 +1,124 @@
+"""The shared per-slot heartbeat/stall state machine (ROADMAP item 3's
+fold-the-duplicate follow-on).
+
+Both watchers — ``train.supervisor.supervise`` (one child) and
+``elastic.ElasticCoordinator`` (one monitor per host slot) — used to
+carry this logic shape-for-shape: the fresh-baseline touch before each
+spawn, the deleted-file recreate, the first-beat-vs-grace split, and the
+re-read-before-verdict double check. A fix landing in one copy could
+silently miss the other; this module is the single implementation both
+drive.
+
+The protocol (unchanged from the supervisor's original):
+
+- ``reset()`` touches the file and records its mtime as the BASELINE:
+  only a *strictly newer* mtime proves the watched child itself beat, so
+  the cold-start grace window (compile can dwarf a step) governs until
+  the first beat.
+- ``poll()`` returns this instant's verdict — ``"ok"``, or ``"stall"``
+  when the child never beat within ``grace_s``, or beat and then went
+  silent past ``stall_timeout_s``. Before a stale-age verdict the mtime
+  is RE-READ: a beat can land between the sample and the verdict (slow
+  poll iteration, laggy shared-filesystem mtime), and a SIGKILL on a
+  live, progressing child costs a full restart for nothing.
+- A deleted heartbeat file (an external /tmp cleaner on a multi-day run)
+  is recreated with the baseline reset rather than raised — a dead
+  watcher orphans the detached child it was guarding — and first-beat
+  detection stays honest against the fresh baseline.
+- ``recheck()`` is the final sweep after the child exits (and, for the
+  coordinator, before generation-wide kills freeze the mtimes): the last
+  beat may have landed inside the last poll window, and classifying a
+  crash-seconds-after-real-progress as a startup failure would hand it
+  the permanent-failure verdict.
+
+Stdlib-only, like both of its drivers.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def touch_heartbeat(path: str) -> None:
+    """Create-or-touch the liveness file (both halves of the heartbeat
+    protocol use this: the trainer to beat, a watcher to reset the
+    baseline before each spawn)."""
+    with open(path, "a"):
+        os.utime(path, None)
+
+
+class HeartbeatMonitor:
+    """One watched heartbeat file's liveness state.
+
+    ``beaten`` is sticky: once the child has proven liveness, a later
+    quiet spell is judged against ``stall_timeout_s``, never against the
+    startup grace again. ``age_s`` holds the heartbeat age observed at
+    the most recent ``poll()`` — the number a stall verdict logs.
+    """
+
+    def __init__(self, path: str, stall_timeout_s: float, grace_s: float):
+        self.path = path
+        self.stall_timeout_s = stall_timeout_s
+        self.grace_s = grace_s
+        self.beaten = False
+        self.age_s = 0.0
+        self._base = 0.0
+        self._started = 0.0
+
+    def reset(self) -> None:
+        """Fresh baseline for a new spawn: a stale file from the previous
+        child must neither trigger nor mask a stall verdict for this
+        one."""
+        touch_heartbeat(self.path)
+        self._base = os.path.getmtime(self.path)
+        self._started = time.monotonic()
+        self.beaten = False
+        self.age_s = 0.0
+
+    def _mtime(self) -> float:
+        try:
+            return os.path.getmtime(self.path)
+        except OSError:
+            # Deleted externally: recreate rather than crash (a dead
+            # watcher leaves the detached child running unsupervised).
+            # Resetting the baseline keeps first-beat detection honest;
+            # the stall clock restarts from the fresh touch.
+            touch_heartbeat(self.path)
+            self._base = os.path.getmtime(self.path)
+            return self._base
+
+    def poll(self) -> str:
+        """One watcher-poll verdict: ``"ok"`` or ``"stall"``."""
+        mtime = self._mtime()
+        if mtime > self._base:
+            self.beaten = True
+        # lint: allow-wall-clock(file mtimes are epoch-based)
+        age = time.time() - mtime
+        if not self.beaten:
+            if time.monotonic() - self._started > self.grace_s:
+                self.age_s = age
+                return "stall"  # never came up at all
+        elif age > self.stall_timeout_s:
+            # Re-read immediately before the verdict (see module doc).
+            try:
+                # lint: allow-wall-clock(file mtimes are epoch-based)
+                age = time.time() - os.path.getmtime(self.path)
+            except OSError:
+                pass
+            if age > self.stall_timeout_s:
+                self.age_s = age
+                return "stall"
+        self.age_s = age
+        return "ok"
+
+    def recheck(self) -> bool:
+        """Final beat sweep after the child exited: returns (and records)
+        whether the child ever beat — the startup-vs-run-failure
+        discriminator."""
+        try:
+            if os.path.getmtime(self.path) > self._base:
+                self.beaten = True
+        except OSError:
+            pass
+        return self.beaten

@@ -27,6 +27,7 @@ import numpy as np
 
 from featurenet_tpu import faults, obs
 from featurenet_tpu.config import Config
+from featurenet_tpu.obs import perf as obs_perf
 from featurenet_tpu.data.dataset import (
     SyntheticVoxelDataset,
     prefetch_to_device,
@@ -87,6 +88,13 @@ class Trainer:
         self.spatial = self.rt.spatial
         self.model = self.rt.model
         self.tx = self.rt.tx
+        # Performance attribution (obs.perf): the device-kind peak row
+        # (explicit `unknown` tier on CPU — no MFU sample is ever
+        # synthesized from a missing peak) and the cost counters of the
+        # last dispatched program, folded against measured step wall in
+        # run()'s loop.
+        self._peaks = obs_perf.local_device_peaks()
+        self._last_cost: Optional[dict] = None
         # TB events from host 0 only (multi-host runs would double-write).
         self.logger = MetricLogger(
             tb_dir=cfg.tb_dir if jax.process_index() == 0 else None
@@ -328,6 +336,13 @@ class Trainer:
             # the supervisor's stall verdict.
             obs.observe("heartbeat_age_s", round(now - last, 3))
         self._last_beat = now
+        if self.cfg.poll_device_memory:
+            # Opt-in device-memory watermark (obs.perf): sampled here —
+            # the heartbeat cadence — because every beat already sits off
+            # the dispatch hot path (a completed readback/eval/
+            # checkpoint). Degrades silently to no events on backends
+            # without memory_stats (CPU).
+            obs_perf.sample_device_memory()
         if self.cfg.heartbeat_file:
             from featurenet_tpu.train.supervisor import touch_heartbeat
 
@@ -369,17 +384,19 @@ class Trainer:
             # executable — actual device time surfaces at the readback.
             with obs.span("data_wait", take=take):
                 batches = tuple(next(stream) for _ in range(take))
+            fn = self._program("multi_train_step", num_steps=self._k)
             with obs.span("dispatch", take=take):
-                self.state, metrics = self._program(
-                    "multi_train_step", num_steps=self._k
-                )(self.state, batches, self._step_rng)
+                self.state, metrics = fn(self.state, batches, self._step_rng)
         else:
             with obs.span("data_wait", take=1):
                 batch = next(stream)
+            fn = self._program("train_step")
             with obs.span("dispatch", take=1):
-                self.state, metrics = self._program("train_step")(
-                    self.state, batch, self._step_rng
-                )
+                self.state, metrics = fn(self.state, batch, self._step_rng)
+        # The dispatched program's compiled counters (obs.perf): the fused
+        # program's flops already cover its whole dispatch group, so the
+        # MFU fold in run() divides by the group wall, not per step.
+        self._last_cost = getattr(fn, "cost", None)
         return metrics
 
     def recalibrate_bn(self, batches: int = 64) -> None:
@@ -573,7 +590,8 @@ class Trainer:
                 metrics = self.dispatch_group(stream, take)
                 new_step = step + take
                 pending.append(metrics["loss"])
-                if len(pending) > max(cfg.max_inflight_steps // take, 1):
+                paced = len(pending) > max(cfg.max_inflight_steps // take, 1)
+                if paced:
                     with obs.span("readback", step=new_step):
                         float(pending.popleft())  # readback = progress proof
                     self._heartbeat()
@@ -582,10 +600,19 @@ class Trainer:
                 # — those are their own spans, and folding them in would
                 # make the p99-vs-median tail alert fire on every healthy
                 # eval boundary).
-                obs.observe(
-                    "step_ms",
-                    round((time.perf_counter() - t_iter) / take * 1e3, 3),
-                )
+                group_wall = time.perf_counter() - t_iter
+                obs.observe("step_ms", round(group_wall / take * 1e3, 3))
+                if paced:
+                    # Perf attribution: compiled flops/bytes over the
+                    # group wall feed the rolling mfu / achieved-bw
+                    # windows — but ONLY on iterations whose wall was
+                    # bounded by a real readback. While the dispatch
+                    # pipeline is still filling, the wall is enqueue time
+                    # alone (sub-ms against tens of ms of device work)
+                    # and would fabricate impossible MFU samples >> 1.
+                    obs_perf.observe_dispatch(
+                        self._last_cost, group_wall, peaks=self._peaks
+                    )
                 if trace_active and (
                     new_step >= trace_start + cfg.profile_steps
                     or new_step == total

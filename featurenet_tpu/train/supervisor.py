@@ -35,14 +35,15 @@ import time
 from typing import Optional, Sequence
 
 from featurenet_tpu import faults
-
-
-def touch_heartbeat(path: str) -> None:
-    """Create-or-touch the liveness file (both halves of the heartbeat
-    protocol use this: the Trainer to beat, the supervisor to reset the
-    baseline before each spawn)."""
-    with open(path, "a"):
-        os.utime(path, None)
+# The heartbeat/stall state machine lives in train.heartbeat — ONE
+# implementation driven by this supervisor and by the elastic
+# coordinator's per-slot monitors (a fix in one watcher used to be able
+# to silently miss the other). touch_heartbeat is re-exported here: the
+# Trainer's beat path and older callers import it from this module.
+from featurenet_tpu.train.heartbeat import (  # noqa: F401
+    HeartbeatMonitor,
+    touch_heartbeat,
+)
 
 
 # Child exit code meaning "checkpointed and asking to be respawned" (the
@@ -310,15 +311,17 @@ def supervise(
     consec_failures = 0
     spawns = 0
     rng = random.Random()  # jitter source; never drives test-visible counts
+    # The shared heartbeat/stall state machine (train.heartbeat): baseline
+    # touch, first-beat-vs-grace split, deleted-file recreate, and the
+    # re-read-before-verdict double check all live there — the elastic
+    # coordinator drives the identical monitor per slot.
+    mon = HeartbeatMonitor(heartbeat_file, stall_timeout_s, grace)
     while True:
-        # Fresh heartbeat so a stale file from the previous child can't
-        # trigger (or mask) a stall verdict for this one. Its mtime is the
-        # baseline: only a *newer* mtime proves the child itself beat, so
-        # the cold-start grace (compile >> step time) governs until then.
-        touch_heartbeat(heartbeat_file)
-        base_mtime = os.path.getmtime(heartbeat_file)
-        started = time.monotonic()
-        first_beat_seen = False
+        # Fresh baseline per spawn: a stale file from the previous child
+        # can't trigger (or mask) a stall verdict for this one; only a
+        # *newer* mtime proves the child itself beat, so the cold-start
+        # grace (compile >> step time) governs until then.
+        mon.reset()
         # Per-child stream window: only lines appended from here on are
         # linted for the exit-0 verdict below AND folded into the segment
         # report the self-pinning gate judges.
@@ -340,54 +343,23 @@ def supervise(
             if rc is not None:
                 break
             time.sleep(poll_s)
-            try:
-                mtime = os.path.getmtime(heartbeat_file)
-            except OSError:
-                # Deleted externally (a /tmp cleaner on a multi-day run):
-                # recreate rather than crash — a dead supervisor leaves the
-                # detached child running unsupervised. Resetting the
-                # baseline keeps first-beat detection honest; the stall
-                # clock restarts from now.
-                touch_heartbeat(heartbeat_file)
-                mtime = base_mtime = os.path.getmtime(heartbeat_file)
-            # lint: allow-wall-clock(file mtimes are epoch-based)
-            age = time.time() - mtime
-            if not first_beat_seen:
-                if mtime > base_mtime:
-                    first_beat_seen = True  # child has produced a beat
-                elif time.monotonic() - started > grace:
-                    stalled = True  # never came up at all
-            elif age > stall_timeout_s:
-                # Re-read immediately before the verdict: a beat can land
-                # between the sample above and here (slow poll iteration,
-                # laggy shared-filesystem mtime) and a SIGKILL on a live,
-                # progressing child costs a full restart for nothing.
-                try:
-                    # lint: allow-wall-clock(file mtimes are epoch-based)
-                    age = time.time() - os.path.getmtime(heartbeat_file)
-                except OSError:
-                    pass
-                if age > stall_timeout_s:
-                    stalled = True
-            if stalled:
+            if mon.poll() == "stall":
+                stalled = True
                 log(json.dumps({
                     "supervisor": "stall", "pid": proc.pid,
-                    "heartbeat_age_s": round(age, 1),
+                    "heartbeat_age_s": round(mon.age_s, 1),
                 }))
-                record("stall", pid=proc.pid, heartbeat_age_s=round(age, 1))
+                record("stall", pid=proc.pid,
+                       heartbeat_age_s=round(mon.age_s, 1))
                 _kill_tree(proc)
                 rc = proc.returncode
                 break
-        if not first_beat_seen:
-            # The final beat may have landed inside the last poll window
-            # (poll sleeps, then the loop breaks on proc.poll() without
-            # re-sampling) — re-read before classifying this exit as a
-            # startup failure, or a crash seconds after real progress gets
-            # the permanent-failure treatment.
-            try:
-                first_beat_seen = os.path.getmtime(heartbeat_file) > base_mtime
-            except OSError:
-                pass
+        # The final beat may have landed inside the last poll window
+        # (poll sleeps, then the loop breaks on proc.poll() without
+        # re-sampling) — re-check before classifying this exit as a
+        # startup failure, or a crash seconds after real progress gets
+        # the permanent-failure treatment.
+        first_beat_seen = mon.recheck()
         telemetry_bad = False
         if not stalled and rc == 0 and run_dir and validate_telemetry:
             # Exit 0 is a *claim*; the event lines this child appended are

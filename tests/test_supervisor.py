@@ -280,6 +280,66 @@ def test_cli_refuses_restart_every_without_supervise(tmp_path):
                   "--checkpoint-dir", str(tmp_path / "ck")])
 
 
+def test_heartbeat_monitor_first_beat_vs_grace_split(tmp_path):
+    """The shared state machine (train.heartbeat) both watchers drive:
+    before the first beat only the grace window governs; after it, the
+    stall timeout does — and `beaten` is sticky."""
+    import time as _time
+
+    from featurenet_tpu.train.heartbeat import (
+        HeartbeatMonitor,
+        touch_heartbeat,
+    )
+
+    hb = str(tmp_path / "hb")
+    mon = HeartbeatMonitor(hb, stall_timeout_s=0.2, grace_s=0.5)
+    mon.reset()
+    # Un-beaten within grace: ok, even though the baseline mtime is "old"
+    # relative to the (shorter) stall timeout.
+    _time.sleep(0.3)
+    assert mon.poll() == "ok" and not mon.beaten
+    # A beat (newer mtime than the baseline) flips beaten.
+    touch_heartbeat(hb)
+    assert mon.poll() == "ok" and mon.beaten
+    # Silence past the stall timeout after a beat is the stall verdict.
+    _time.sleep(0.3)
+    assert mon.poll() == "stall"
+    assert mon.age_s > 0.2
+    # Never-came-up: a fresh monitor past grace with no beat stalls too.
+    mon2 = HeartbeatMonitor(hb, stall_timeout_s=60.0, grace_s=0.1)
+    mon2.reset()
+    _time.sleep(0.25)
+    assert mon2.poll() == "stall" and not mon2.beaten
+
+
+def test_heartbeat_monitor_recreates_deleted_file_and_rechecks(tmp_path):
+    import time as _time
+
+    from featurenet_tpu.train.heartbeat import (
+        HeartbeatMonitor,
+        touch_heartbeat,
+    )
+
+    hb = str(tmp_path / "hb")
+    mon = HeartbeatMonitor(hb, stall_timeout_s=60.0, grace_s=60.0)
+    mon.reset()
+    os.unlink(hb)
+    # Deletion is never fatal: the file is recreated with a fresh
+    # baseline and the verdict stays ok.
+    assert mon.poll() == "ok"
+    assert os.path.exists(hb)
+    # recheck() catches a beat that landed after the last poll — the
+    # startup-vs-run-failure discriminator both watchers consult after
+    # a child exit.
+    assert mon.recheck() is False
+    _time.sleep(0.05)
+    touch_heartbeat(hb)
+    assert mon.recheck() is True
+    # And recheck on a deleted file degrades to the sticky value.
+    os.unlink(hb)
+    assert mon.recheck() is True
+
+
 def test_supervised_child_passes_restart_every_guard(tmp_path):
     """The supervisor's respawned child carries --restart-every with
     --supervise stripped (child_argv_from_cli re-passes it each spawn) plus
