@@ -280,6 +280,17 @@ def _measure_round(platform: str) -> dict:
     flag = measure_train_step(
         cfg, batch_per_chip=cfg.global_batch, repeats=REPEATS
     )
+    # Mixed-precision training rung (ROADMAP item 2): the flagship
+    # measured under the bf16-master policy (fp32 master weights in the
+    # optimizer, bf16 working copy + bf16 gradient storage inside the
+    # step — train/precision.py), same converged-slope protocol in the
+    # same session so the fp32 row above is the honest denominator. The
+    # row's own measured-cost MFU / compiled peak-HBM ride along so the
+    # perf table attributes WHERE the delta came from.
+    bf16 = measure_train_step(
+        get_config("sprint64", train_precision="bf16_master"),
+        batch_per_chip=cfg.global_batch, repeats=REPEATS,
+    )
     wcfg = get_config("warp64")
     warp = measure_train_step(
         wcfg, batch_per_chip=wcfg.global_batch, repeats=REPEATS
@@ -446,6 +457,17 @@ def _measure_round(platform: str) -> dict:
         **{k: flag[k] for k in
            ("mfu_train", "hbm_peak_train_bytes", "train_roofline")
            if k in flag},
+        # The bf16-master training row (same arch/batch/protocol as the
+        # fp32 headline above; `vs_fp32` is the rung's measured payoff).
+        "train_sps_bf16_master": bf16["samples_per_sec_per_chip"],
+        "train_bf16_master_spread_pct": bf16["spread_pct"],
+        "train_bf16_master_vs_fp32": round(
+            bf16["samples_per_sec_per_chip"]
+            / max(flag["samples_per_sec_per_chip"], 1e-9), 3
+        ),
+        **{f"{k}_bf16_master": bf16[k] for k in
+           ("mfu_train", "hbm_peak_train_bytes", "train_roofline")
+           if k in bf16},
         **({"serve_mfu": serving["serve_mfu"]}
            if "serve_mfu" in serving else {}),
         "serving_inferences_per_sec_per_chip":
@@ -526,6 +548,10 @@ def _measure_round(platform: str) -> dict:
         ("mfu_train", 0.02),
         ("serve_mfu", 0.02),
         ("hbm_peak_train_bytes", 32.0 * 1024 * 1024),
+        # The bf16-master row's pins mirror its fp32 siblings.
+        ("train_bf16_master_spread_pct", SPREAD_TOLERANCE_ABS),
+        ("mfu_train_bf16_master", 0.02),
+        ("hbm_peak_train_bytes_bf16_master", 32.0 * 1024 * 1024),
         ("window_data_wait_p50_ms", 1.0),
         ("window_data_wait_p99_ms", 5.0),
         ("window_queue_depth_p50", 1.0),

@@ -320,6 +320,29 @@ def _scope_nodes(scope: ast.AST):
             stack.extend(ast.iter_child_nodes(node))
 
 
+# Modules whose compiled train step is under the precision-cast
+# contract: with the bf16_master policy (train/precision.py) every fp32
+# cast inside the hot step is a numerics decision — a stray one silently
+# re-widens part of the working step back to fp32, eating the rung's
+# win without failing anything. Deliberate casts carry
+# ``# lint: allow-precision(<why fp32 here>)``.
+PRECISION_CAST_MODULES = ("train/steps.py",)
+
+
+def _is_fp32_cast(node: ast.Call) -> Optional[str]:
+    """The human name of an fp32-cast construct, or None."""
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr == "astype" and node.args):
+        a = node.args[0]
+        if (isinstance(a, ast.Attribute) and a.attr == "float32"
+                and isinstance(a.value, ast.Name) and a.value.id == "jnp"):
+            return ".astype(jnp.float32)"
+    if (isinstance(f, ast.Attribute) and f.attr == "float32"
+            and isinstance(f.value, ast.Name) and f.value.id == "jnp"):
+        return "jnp.float32(...)"
+    return None
+
+
 @register("hygiene")
 def hygiene_rule(tree: Tree) -> list[Finding]:
     """Timing and concurrency footguns the obs/faults layers already paid
@@ -335,6 +358,11 @@ def hygiene_rule(tree: Tree) -> list[Finding]:
     - ``threading.Thread`` without an explicit ``daemon=``: an implicit
       non-daemon worker blocks interpreter exit exactly when the run is
       being torn down by a fault.
+    - fp32 casts (``.astype(jnp.float32)`` / ``jnp.float32(...)``) inside
+      the compiled train step (``PRECISION_CAST_MODULES``) without a
+      ``# lint: allow-precision(<reason>)`` annotation: under the
+      bf16_master policy an unexplained widen-back is a silent hole in
+      the mixed-precision rung.
     """
     findings: list[Finding] = []
     for mod in tree.modules:
@@ -411,6 +439,25 @@ def hygiene_rule(tree: Tree) -> list[Finding]:
                             "an implicit non-daemon worker blocks "
                             "interpreter exit during fault teardown",
                         ))
+        if mod.relpath in PRECISION_CAST_MODULES:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                what = _is_fp32_cast(node)
+                if what is None:
+                    continue
+                if mod.suppressed(node.lineno, "precision"):
+                    continue
+                findings.append(Finding(
+                    "hygiene", "fp32_cast_in_hot_step", mod.path,
+                    node.lineno,
+                    f"{what} inside the compiled train step "
+                    f"({mod.relpath}) — under the bf16_master policy an "
+                    "unexplained fp32 cast silently re-widens the "
+                    "working step; annotate the line with # lint: "
+                    "allow-precision(<why fp32 here>) or move the cast "
+                    "out of the hot step",
+                ))
     return findings
 
 

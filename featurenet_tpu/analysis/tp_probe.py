@@ -1,0 +1,204 @@
+"""Layer-by-layer numerics bisection of the tensor-parallel divergence.
+
+The two seed-verified tier-1 failures (tests/test_parallel.py::
+``test_tp_matches_single_device`` / ``test_spatial_partitioning_matches_
+single_device``, loss 3.0999 vs 3.3043 on this jax line) diverge ~6% in
+loss between a ``data=4 x model=2`` mesh and unsharded execution. This
+probe localizes WHERE the computation first disagrees instead of
+eyeballing the end-to-end loss:
+
+1. **Per-module forward bisection** (flax ``capture_intermediates``):
+   every module output of the eval-mode forward compared between the TP
+   mesh and a single device — a diverging conv/Dense/BN block shows up
+   as the first intermediate over tolerance.
+2. **Mechanism A/B**: the full train-mode forward with dropout DISABLED
+   vs ENABLED — separating batch-stat BN reduction order (benign float
+   noise) from the dropout mask itself.
+3. **Fix verification** (optional): re-run the diverging configuration
+   under ``jax_threefry_partitionable=True`` and report whether the
+   divergence closes.
+
+Finding as of the first run (recorded in ROADMAP): every eval-mode
+intermediate matches to float noise (<=1e-4) and train mode WITHOUT
+dropout matches too — the first (and only) diverging "layer" is the
+**dropout mask**. With ``jax_threefry_partitionable=False`` (this jax
+version's default) the bits jax.random generates under GSPMD depend on
+how the partitioner shards the consuming computation, so the mask over
+the model-axis-sharded ``[B, hidden]`` activation differs from the
+single-device mask (~21% of elements). Under
+``jax_threefry_partitionable=True`` the TP update matches the
+single-device update BITWISE — the fix is the flag, deferred because it
+changes every seeded RNG stream in the suite.
+
+Run it:  ``python -m featurenet_tpu.analysis.tp_probe [--no-verify-fix]``
+(needs >= 2 devices; CI's 8-CPU-device harness qualifies). Imports are
+function-local so ``featurenet_tpu.analysis`` stays importable with no
+ML stack (the lint engine's contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def _flatten_paths(tree) -> list[tuple[str, object]]:
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [
+        ("/".join(getattr(k, "key", str(k)) for k in path), leaf)
+        for path, leaf in flat
+    ]
+
+
+def probe(resolution: int = 16, batch: int = 16, tolerance: float = 1e-3,
+          verify_fix: bool = True, seed: int = 0) -> dict:
+    """Run the bisection; returns ``{"rows": [...], "verdict": {...}}``.
+
+    Each row is one compared quantity (a module intermediate, a
+    mechanism A/B stage) with its max abs difference between the
+    ``data x model=2`` mesh and single-device execution. The verdict
+    names the first diverging stage and, with ``verify_fix``, whether
+    ``jax_threefry_partitionable=True`` closes it.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.models import FeatureNet
+    from featurenet_tpu.models.featurenet import tiny_arch
+    from featurenet_tpu.parallel.mesh import (
+        batch_shardings,
+        make_mesh,
+        replicated,
+        state_shardings,
+    )
+    from featurenet_tpu.train.state import create_state
+    from featurenet_tpu.train.steps import make_optimizer
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "tp_probe needs >= 2 devices (the CI harness forces 8 CPU "
+            "devices; see tests/conftest.py)"
+        )
+    from featurenet_tpu.config import get_config
+
+    host_batch = generate_batch(
+        np.random.default_rng(seed), batch, resolution=resolution
+    )
+    cfg = get_config("smoke16", global_batch=batch)
+    tx = make_optimizer(cfg)
+    mesh_tp = make_mesh(model=2)
+    mesh_1 = make_mesh(data=1, model=1, devices=jax.devices()[:1])
+
+    def forward(mesh, arch, train, capture):
+        """One jitted forward on ``mesh``; returns (logits, intermediates
+        or None). fp32 model so only sharding (not bf16 rounding) can
+        explain a diff."""
+        model = FeatureNet(arch=arch, dtype=jnp.float32)
+
+        def init_fn(r):
+            sample = jnp.zeros(host_batch["voxels"].shape, jnp.float32)
+            return create_state(model, tx, sample, r)
+
+        abstract = jax.eval_shape(init_fn, jax.random.key(0))
+        st_sh = state_shardings(abstract, mesh)
+        state = jax.jit(init_fn, out_shardings=st_sh)(jax.random.key(0))
+        b_sh = batch_shardings(mesh)
+
+        def fwd(params, stats, vox, r):
+            mutable = (["intermediates"] if capture else [])
+            mutable += (["batch_stats"] if train else [])
+            out = model.apply(
+                {"params": params, "batch_stats": stats}, vox, train=train,
+                rngs={"dropout": r} if train else None,
+                mutable=mutable or False,
+                capture_intermediates=capture,
+            )
+            return out if mutable else (out, {})
+
+        f = jax.jit(fwd, in_shardings=(
+            st_sh.params, st_sh.batch_stats, b_sh["voxels"],
+            replicated(mesh),
+        ))
+        logits, mutated = f(
+            state.params, state.batch_stats,
+            jax.device_put(host_batch["voxels"], b_sh["voxels"]),
+            jax.device_put(jax.random.key(seed + 1), replicated(mesh)),
+        )
+        inter = mutated.get("intermediates") if isinstance(mutated, dict) \
+            else None
+        return np.asarray(logits), inter
+
+    rows: list[dict] = []
+    arch = tiny_arch()
+
+    # --- stage 1: per-module eval-mode bisection ----------------------------
+    log_tp, inter_tp = forward(mesh_tp, arch, train=False, capture=True)
+    log_1, inter_1 = forward(mesh_1, arch, train=False, capture=True)
+    for (path, a), (_, b) in zip(_flatten_paths(inter_tp),
+                                 _flatten_paths(inter_1)):
+        rows.append({
+            "stage": f"forward/eval/{path}",
+            "max_abs_diff": float(np.abs(np.asarray(a) - np.asarray(b))
+                                  .max()),
+        })
+    rows.append({"stage": "forward/eval/logits",
+                 "max_abs_diff": float(np.abs(log_tp - log_1).max())})
+
+    # --- stage 2: mechanism A/B — batch-stat BN vs the dropout mask ---------
+    no_dropout = dataclasses.replace(arch, dropout=0.0)
+    for label, a in (("forward/train-no-dropout", no_dropout),
+                     ("forward/train-dropout", arch)):
+        lt, _ = forward(mesh_tp, a, train=True, capture=False)
+        l1, _ = forward(mesh_1, a, train=True, capture=False)
+        rows.append({"stage": label,
+                     "max_abs_diff": float(np.abs(lt - l1).max())})
+
+    diverging = [r for r in rows if r["max_abs_diff"] > tolerance]
+    verdict: dict = {
+        "tolerance": tolerance,
+        "first_divergence": diverging[0]["stage"] if diverging else None,
+        "threefry_partitionable": bool(
+            jax.config.jax_threefry_partitionable
+        ),
+    }
+
+    # --- stage 3: does jax_threefry_partitionable close it? -----------------
+    if verify_fix and diverging:
+        prev = bool(jax.config.jax_threefry_partitionable)
+        try:
+            jax.config.update("jax_threefry_partitionable", True)
+            lt, _ = forward(mesh_tp, arch, train=True, capture=False)
+            l1, _ = forward(mesh_1, arch, train=True, capture=False)
+            d = float(np.abs(lt - l1).max())
+        finally:
+            jax.config.update("jax_threefry_partitionable", prev)
+        verdict["partitionable_train_dropout_max_abs_diff"] = d
+        verdict["fixed_by_threefry_partitionable"] = d <= tolerance
+    return {"rows": rows, "verdict": verdict}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--resolution", type=int, default=16)
+    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--tolerance", type=float, default=1e-3)
+    parser.add_argument("--no-verify-fix", action="store_true",
+                        help="skip the jax_threefry_partitionable=True "
+                             "re-run")
+    args = parser.parse_args()
+    out = probe(resolution=args.resolution, batch=args.batch,
+                tolerance=args.tolerance,
+                verify_fix=not args.no_verify_fix)
+    for row in out["rows"]:
+        print(json.dumps(row))
+    print(json.dumps({"verdict": out["verdict"]}))
+
+
+if __name__ == "__main__":
+    main()

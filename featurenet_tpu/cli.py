@@ -34,6 +34,15 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--seed", type=int)
     p.add_argument("--grad-clip", type=float, dest="grad_clip",
                    help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--train-precision", choices=["fp32", "bf16_master"],
+                   dest="train_precision",
+                   help="training precision policy "
+                        "(featurenet_tpu.train.precision): bf16_master "
+                        "keeps fp32 master weights in the optimizer while "
+                        "the compiled step runs a bf16 working copy "
+                        "(bf16 gradient storage, fp32 update); masters "
+                        "are what checkpoints persist, so modes restore "
+                        "into each other (default fp32)")
     p.add_argument("--checkpoint-dir")
     p.add_argument("--mesh-model", type=int)
     p.add_argument("--data-workers", type=int)
@@ -208,6 +217,7 @@ def _overrides(args) -> dict:
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "run_dir", "heartbeat_file", "seg_loss",
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
+        "train_precision",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
         "augment_translate_vox", "init_from", "inject_faults",
         "alert_rules", "exec_cache_dir", "min_world_size",
@@ -441,6 +451,13 @@ def main(argv=None) -> None:
     p_prog.add_argument("--config", default="pod64",
                         help="preset whose program catalog to list "
                              "(default pod64)")
+    p_prog.add_argument("--train-precision",
+                        choices=["fp32", "bf16_master"],
+                        dest="train_precision",
+                        help="enumerate (and --warm build) the train "
+                             "programs under this precision policy; the "
+                             "executable-cache fingerprint separates the "
+                             "two variants (default fp32)")
     p_prog.add_argument("--warm", action="store_true",
                         help="build every applicable program (AOT warmup; "
                              "with --exec-cache-dir, populates the "
@@ -624,10 +641,12 @@ def main(argv=None) -> None:
         from featurenet_tpu.config import get_config
         from featurenet_tpu.runtime import list_programs
 
-        cfg = get_config(args.config, **(
-            {"exec_cache_dir": args.exec_cache_dir}
-            if args.exec_cache_dir else {}
-        ))
+        prog_over = {}
+        if args.exec_cache_dir:
+            prog_over["exec_cache_dir"] = args.exec_cache_dir
+        if getattr(args, "train_precision", None):
+            prog_over["train_precision"] = args.train_precision
+        cfg = get_config(args.config, **prog_over)
         if args.run_dir:
             from featurenet_tpu import obs
             from featurenet_tpu.config import config_to_dict

@@ -104,6 +104,16 @@ class Config:
     # rough loss surfaces (the warp64 stride-4 stem's mid-schedule eval
     # collapses — BASELINE.md round-3/4 recipe study).
     grad_clip: float = 0.0
+    # Training precision policy (train/precision.py): "fp32" (the
+    # identity — fp32 params through the step, unchanged executable) or
+    # "bf16_master" (the optimizer holds fp32 master weights while the
+    # jitted step casts a bf16 working copy for forward/backward, stores
+    # bf16 gradients, and upcasts them to fp32 for the update). Masters
+    # are what checkpoints persist, so a checkpoint restores bitwise
+    # across modes; the runtime registry fingerprints the two train
+    # executables apart (a bf16-master world never loads an fp32
+    # program). Run policy, not identity.
+    train_precision: str = "fp32"
 
     # Parallelism (mesh axis sizes; None = use all available devices on data).
     mesh_data: Optional[int] = None
@@ -272,6 +282,14 @@ class Config:
             _rules(self.alert_rules)
         if self.seg_loss not in ("balanced_ce", "ce_dice", "dice"):
             raise ValueError(f"unknown seg_loss {self.seg_loss!r}")
+        if self.train_precision not in ("fp32", "bf16_master"):
+            # Literal set mirrored by the CLI's --train-precision choices
+            # and train.precision.TRAIN_PRECISIONS (the config-cli lint
+            # rule cross-checks the CLI surface against this guard).
+            raise ValueError(
+                f"unknown train_precision {self.train_precision!r}; one "
+                "of fp32, bf16_master"
+            )
         if self.seg_input_context not in ("none", "proj", "proj_coords"):
             raise ValueError(
                 f"unknown seg_input_context {self.seg_input_context!r}"

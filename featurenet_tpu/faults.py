@@ -75,7 +75,10 @@ SITES = {
     "cache_read_error": "read",          # Nth cache _gather call
     "sink_enospc": "emit",               # Nth EventSink.emit
     "spawn_fail": "spawn",               # Nth supervisor child spawn
-    "save_slow": "save",                 # Nth CheckpointManager.save (latency)
+    # Nth CheckpointManager.save (latency; sleeps inside the background
+    # writer's checkpoint_write span — the double-buffered save keeps
+    # the host-blocking enqueue bounded while this write drags).
+    "save_slow": "save",
     # A host vanishing mid-mesh (preempted VM, kernel panic, yanked node):
     # the LAST host of the process group SIGKILLs itself at the first step
     # boundary >= N — no drain, no exit protocol, exactly the shape the

@@ -71,6 +71,13 @@ DIRECTIONS = {
     "mfu_train": "min",
     "serve_mfu": "min",
     "hbm_peak_train_bytes": "max",
+    # Mixed-precision training rung (Config.train_precision): the
+    # bf16-master row regresses like its fp32 siblings — throughput/MFU
+    # downward, compiled peak memory and measurement spread upward.
+    "train_sps_bf16_master": "min",
+    "train_bf16_master_spread_pct": "max",
+    "mfu_train_bf16_master": "min",
+    "hbm_peak_train_bytes_bf16_master": "max",
     "e2e_samples_per_sec": "min",
     "e2e_pipelined_samples_per_sec": "min",
     "e2e_hbm_samples_per_sec": "min",
@@ -184,6 +191,10 @@ BENCH_GATE_KEYS = (
     "mfu_train",
     "serve_mfu",
     "hbm_peak_train_bytes",
+    "train_sps_bf16_master",
+    "train_bf16_master_spread_pct",
+    "mfu_train_bf16_master",
+    "hbm_peak_train_bytes_bf16_master",
     "window_data_wait_p50_ms",
     "window_data_wait_p99_ms",
     "window_queue_depth_p50",

@@ -51,7 +51,10 @@ from featurenet_tpu.obs import events as _events
 # per JAX ``device_kind`` string. Public chip specs; extend this table to
 # teach the layer a new accelerator — an absent entry is the explicit
 # ``unknown`` tier (no MFU, no roofline), never a guessed peak. v5e
-# appears under both strings jax has used for it.
+# appears under both strings jax has used for it. THE single source of
+# the roofline constants: ``ops/flops.py`` (analytic MFU) and
+# ``ops/profile_step.py`` (the step profiler's roofline table) import
+# their v5e peaks from here — a spec correction must land once.
 PEAK_FLOPS_BY_KIND: dict[str, float] = {
     "TPU v2": 45e12,
     "TPU v3": 123e12,
@@ -181,17 +184,22 @@ def roofline(flops: Optional[float], bytes_accessed: Optional[float],
 
 
 def emit_program_cost(name: str, compiled: Any,
-                      peaks: Optional[dict] = None) -> dict:
+                      peaks: Optional[dict] = None,
+                      precision: Optional[str] = None) -> dict:
     """Capture ``compiled``'s cost and emit one ``program_cost`` event
     (``Runtime.build``'s hook). The event always carries ``program`` and
-    ``device_kind``; everything else is whatever the backend could say.
-    Returns the cost dict so the caller can keep it next to the
-    executable (``CompiledProgram.cost``)."""
+    ``device_kind``; ``precision`` (the program's weight-precision label
+    — fp32 / bf16_master / int8) rides along when the caller knows it,
+    so the report's per-program table can attribute a precision-rung
+    delta to the executable that ran it. Everything else is whatever
+    the backend could say. Returns the cost dict so the caller can keep
+    it next to the executable (``CompiledProgram.cost``)."""
     cost = program_cost(compiled)
     if peaks is None:
         peaks = local_device_peaks()
+    extra = {"precision": precision} if precision else {}
     _events.emit("program_cost", program=name,
-                 device_kind=peaks.get("device_kind"), **cost)
+                 device_kind=peaks.get("device_kind"), **extra, **cost)
     return cost
 
 

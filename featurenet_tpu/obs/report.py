@@ -51,8 +51,12 @@ LOOP_CATEGORIES = ("data_wait", "dispatch", "readback", "eval", "checkpoint")
 # against this registry (and LOOP_CATEGORIES coverage) both ways.
 KNOWN_SPAN_NAMES = frozenset({
     *LOOP_CATEGORIES,
-    # checkpoint internals (train/checkpoint.py)
+    # checkpoint internals (train/checkpoint.py): checkpoint_save is the
+    # host-blocking enqueue into the double-buffer; checkpoint_write is
+    # the background writer's Orbax write+finalize (where save_slow
+    # latency lands — off the step path by construction).
     "checkpoint_save", "checkpoint_restore", "checkpoint_wait",
+    "checkpoint_write",
     # serving (infer.py) and the metrics readback (utils/logging.py)
     "infer_batch",
     # the continuous batcher's compiled-forward dispatch (serve/batcher.py)
@@ -401,6 +405,12 @@ def _perf_section(events: list[dict], slo: dict) -> dict:
                       "optimal_seconds"):
                 if isinstance(e.get(k), (int, float)):
                     row[k] = e[k]
+            if e.get("precision"):
+                # Weight-precision label (fp32 / bf16_master / int8):
+                # the column that attributes a precision-rung delta —
+                # this run's train counters belong to THIS policy's
+                # executable, not a generic "train_step".
+                row["precision"] = str(e["precision"])
             if e.get("device_kind"):
                 device_kind = e["device_kind"]
         elif e["ev"] == "program_compile" and e.get("program"):
@@ -864,8 +874,8 @@ def format_report(rep: dict) -> str:
         progs = pf.get("programs") or {}
         if progs:
             lines.append(
-                "  program                   gflops    acc MB   peak MB"
-                "  roofline       compile"
+                "  program                 precision      gflops    acc MB"
+                "   peak MB  roofline       compile"
             )
 
             def cell(v, scale, fmt):
@@ -875,6 +885,7 @@ def format_report(rep: dict) -> str:
                 row = progs[name]
                 lines.append(
                     f"  {name:<22}  "
+                    f"{row.get('precision') or '—':<11}  "
                     f"{cell(row.get('flops'), 1e9, '8.2f'):>8}  "
                     f"{cell(row.get('bytes'), 1e6, '8.1f'):>8}  "
                     f"{cell(row.get('peak_bytes'), 1e6, '8.1f'):>8}"

@@ -21,10 +21,13 @@ from __future__ import annotations
 
 import math
 
+# TPU v5e (v5 lite) peak dense bf16 matmul throughput per chip — imported
+# from the ONE device peak table (obs/perf.py, stdlib-only) that also
+# feeds the measured-MFU windows and the roofline verdicts; this module
+# keeps the historical name for its callers (benchmark.py, bench.py).
+from featurenet_tpu.obs.perf import PEAK_FLOPS_BY_KIND
 
-# TPU v5e (v5 lite) peak dense bf16 matmul throughput per chip. Public spec:
-# 394 TOPS int8 / 197 TFLOP/s bf16.
-PEAK_BF16_FLOPS = 197e12
+PEAK_BF16_FLOPS = PEAK_FLOPS_BY_KIND["TPU v5e"]
 
 
 def conv_stack_forward_flops(

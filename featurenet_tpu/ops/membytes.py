@@ -53,10 +53,25 @@ CONV_BLOCK_TENSORS = 3.2
 FUSED_STEP_RETENTION = 0.12
 
 
-def state_bytes(params_n: int, optimizer: str = "adamw") -> int:
-    """Persistent training-state bytes: fp32 params + optimizer slots +
-    the gradient tree live during the update."""
+def state_bytes(params_n: int, optimizer: str = "adamw",
+                precision: str = "fp32") -> int:
+    """Persistent training-state bytes: fp32 master params + optimizer
+    slots + the gradient tree live during the update.
+
+    ``precision`` is the training precision policy
+    (``train/precision.py``). Under ``bf16_master`` the step additionally
+    holds a bf16 WORKING copy of the params (2 bytes/param) and stores
+    the backward's gradients in bf16 (2) — but the fp32 upcast of those
+    gradients (4) is live through the optimizer update, so first-order
+    both gradient trees are counted alongside the fp32 masters. Net: the
+    master split trades activation-side casts for ~1.25x the state-side
+    bytes (20 vs 16 bytes/param with adamw; 16 vs 12 with sgd) —
+    negligible against activations for these ~4M-param configs, but the
+    model must say it, not hide it."""
     slots = {"adamw": 2, "adam": 2, "sgd": 1}.get(optimizer, 2)
+    if precision == "bf16_master":
+        # masters(4) + working(2) + bf16 grads(2) + fp32 grads(4) + slots
+        return int(params_n * (12 + 4 * slots))
     return int(params_n * 4 * (2 + slots))  # params + grads + slots
 
 
@@ -145,11 +160,15 @@ def act_bytes_per_sample(cfg) -> int:
 
 
 def fused_step_bytes(cfg, k: int, params_n: int, n_rows: int = 0) -> int:
-    """Estimated peak HBM bytes of the k-fused train-step executable."""
+    """Estimated peak HBM bytes of the k-fused train-step executable.
+    The state term follows ``cfg.train_precision`` (master/working split
+    under ``bf16_master``), so the dispatch-k clamp sees the policy the
+    executable will actually compile under."""
     act = act_bytes_per_sample(cfg) * cfg.global_batch
     temp = int(act * (1.0 + FUSED_STEP_RETENTION * (k - 1)))
     return (
-        state_bytes(params_n, cfg.optimizer)
+        state_bytes(params_n, cfg.optimizer,
+                    getattr(cfg, "train_precision", "fp32"))
         + resident_split_bytes(cfg, n_rows)
         + k * wire_batch_bytes(cfg)
         + temp

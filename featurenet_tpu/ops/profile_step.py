@@ -47,9 +47,15 @@ import time
 
 import numpy as np
 
-# TPU v5e single-chip roofline constants (public spec): bf16 peak and HBM BW.
-PEAK_BF16_TFLOPS = 197.0
-HBM_GBPS = 819.0
+# TPU v5e single-chip roofline constants, derived from the ONE device
+# peak table (obs/perf.py) so a spec correction lands everywhere at once.
+from featurenet_tpu.obs.perf import (
+    PEAK_BYTES_PER_SEC_BY_KIND,
+    PEAK_FLOPS_BY_KIND,
+)
+
+PEAK_BF16_TFLOPS = PEAK_FLOPS_BY_KIND["TPU v5e"] / 1e12
+HBM_GBPS = PEAK_BYTES_PER_SEC_BY_KIND["TPU v5e"] / 1e9
 RIDGE_FLOP_PER_BYTE = PEAK_BF16_TFLOPS * 1e12 / (HBM_GBPS * 1e9)  # ~240
 
 

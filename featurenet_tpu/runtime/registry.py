@@ -37,9 +37,12 @@ Program catalog (availability depends on the config):
 ==================  =========================================================
 ``init``            sharded state init (params/opt-state materialized
                     directly on their devices)
-``train_step``      one fused fwd+bwd+optimizer+BN step (donated state)
+``train_step``      one fused fwd+bwd+optimizer+BN step (donated state);
+                    precision-variant per ``Config.train_precision``
+                    (fp32 | bf16_master — the policy is in the cache
+                    fingerprint, so a cross-precision hit is impossible)
 ``multi_train_step``  ``k`` steps fused into one executable
-                    (``steps_per_dispatch > 1``)
+                    (``steps_per_dispatch > 1``); precision-variant
 ``hbm_train_step``  steps that sample batches from the HBM-resident split
                     (``hbm_cache``; needs the resident arrays' shapes)
 ``eval_step``       exact-sum eval forward
@@ -196,12 +199,20 @@ def _always(cfg: Config) -> bool:
 
 
 def _spec_init(rt: "Runtime") -> ProgramSpec:
+    # Precision-variant like the train steps: the initialized TrainState
+    # CARRIES the policy as static pytree metadata, and an AOT-cached
+    # executable's output treedef is baked at lowering — a cached fp32
+    # init served to a bf16_master run would silently hand back a state
+    # whose every later step trains at the wrong precision.
+    prec = rt.cfg.train_precision
     return ProgramSpec(
         name="init",
         fn=rt._init_fn,
         abstract_args=(_key_aval(),),
+        precision=prec,
         out_shardings=rt.state_sh,
-        meta={"kind": "init", "avals": _meta_avals(rt.abstract_state)},
+        meta={"kind": "init", "precision": prec,
+              "avals": _meta_avals(rt.abstract_state)},
     )
 
 
@@ -209,14 +220,24 @@ def _spec_train_step(rt: "Runtime") -> ProgramSpec:
     from featurenet_tpu.train.steps import make_train_step
 
     args = (rt.abstract_state, rt.batch_avals(), _key_aval())
+    # The precision policy lands in the meta (and so in the cache
+    # fingerprint AND the entry filename digest): the fp32 and
+    # bf16_master executables have IDENTICAL avals — fp32 masters in,
+    # fp32 masters out — and only the policy baked into the traced step
+    # distinguishes them. A bf16-master world must never load an fp32
+    # program (or vice versa), so a cross-precision cache hit must be
+    # impossible by construction.
+    prec = rt.cfg.train_precision
     return ProgramSpec(
         name="train_step",
         fn=make_train_step(rt.model, rt.cfg.task, **rt.step_kwargs()),
         abstract_args=args,
+        precision=prec,
         in_shardings=(rt.state_sh, rt.batch_sh, rt.rep),
         out_shardings=(rt.state_sh, rt.rep),
         donate_argnums=(0,),
-        meta={"kind": "train_step", "avals": _meta_avals(args)},
+        meta={"kind": "train_step", "precision": prec,
+              "avals": _meta_avals(args)},
     )
 
 
@@ -235,17 +256,19 @@ def _spec_multi_train_step(rt: "Runtime",
         num_steps = rt.dispatch_k(param_count(rt.abstract_state.params))
     k = max(2, num_steps)
     args = (rt.abstract_state, (rt.batch_avals(),) * k, _key_aval())
+    prec = rt.cfg.train_precision
     return ProgramSpec(
         name="multi_train_step",
         fn=make_multi_train_step(
             rt.model, rt.cfg.task, num_steps=k, **rt.step_kwargs()
         ),
         abstract_args=args,
+        precision=prec,
         in_shardings=(rt.state_sh, (rt.batch_sh,) * k, rt.rep),
         out_shardings=(rt.state_sh, rt.rep),
         donate_argnums=(0,),
         meta={"kind": "multi_train_step", "num_steps": k,
-              "avals": _meta_avals(args)},
+              "precision": prec, "avals": _meta_avals(args)},
     )
 
 
@@ -266,6 +289,7 @@ def _spec_hbm_train_step(rt: "Runtime", num_steps: int = 1,
     cfg = rt.cfg
     d_sh = NamedSharding(rt.mesh, P("data"))
     args = (rt.abstract_state, _aval_of(data), _aval_of(targets), _key_aval())
+    prec = cfg.train_precision
     return ProgramSpec(
         name="hbm_train_step",
         fn=make_hbm_multi_train_step(
@@ -281,11 +305,12 @@ def _spec_hbm_train_step(rt: "Runtime", num_steps: int = 1,
             affine_opts=rt.step_kwargs()["affine_opts"],
         ),
         abstract_args=args,
+        precision=prec,
         in_shardings=(rt.state_sh, d_sh, d_sh, rt.rep),
         out_shardings=(rt.state_sh, rt.rep),
         donate_argnums=(0,),
         meta={"kind": "hbm_train_step", "num_steps": num_steps,
-              "avals": _meta_avals(args)},
+              "precision": prec, "avals": _meta_avals(args)},
     )
 
 
@@ -454,6 +479,26 @@ PROGRAMS: dict[str, tuple[Callable, str, Callable[[Config], bool]]] = {
 _NEEDS_RUNTIME_ARGS = frozenset({"hbm_train_step"})
 
 
+# Programs whose compiled executable embeds the TRAINING precision
+# policy (Config.train_precision): the train steps cast/apply under it,
+# and init bakes it into the returned state's static metadata. Serving
+# and eval run the fp32 masters (or int8-quantized weights) regardless.
+TRAIN_PRECISION_PROGRAMS = frozenset(
+    {"init", "train_step", "multi_train_step", "hbm_train_step"}
+)
+
+
+def program_precision(cfg: Config, name: str) -> str:
+    """The weight-precision label of one catalog program under ``cfg`` —
+    the ``cli programs`` column and the listing half of the precision
+    variants (the build half lives in each spec's meta/fingerprint)."""
+    if name.endswith("int8"):
+        return "int8"
+    if name in TRAIN_PRECISION_PROGRAMS:
+        return cfg.train_precision
+    return "fp32"
+
+
 def list_programs(cfg: Config) -> list[dict]:
     """Enumerate the catalog for ``cfg`` WITHOUT building anything — the
     ``cli programs`` listing (name, doc, precision, applicability)."""
@@ -462,7 +507,7 @@ def list_programs(cfg: Config) -> list[dict]:
         rows.append({
             "program": name,
             "doc": doc,
-            "precision": "int8" if name.endswith("int8") else "fp32",
+            "precision": program_precision(cfg, name),
             "applicable": bool(applicable(cfg)),
         })
     return rows
@@ -515,7 +560,8 @@ class Runtime:
 
         def init_fn(rng):
             sample = jnp.zeros(sample_shape, jnp.float32)
-            return create_state(self.model, self.tx, sample, rng)
+            return create_state(self.model, self.tx, sample, rng,
+                                precision=cfg.train_precision)
 
         self._init_fn = init_fn
         self.abstract_state = jax.eval_shape(init_fn, _key_aval())
@@ -689,7 +735,8 @@ class Runtime:
         # yields an honestly partial (possibly empty) cost dict.
         from featurenet_tpu.obs import perf as _perf
 
-        cost = _perf.emit_program_cost(spec.name, compiled)
+        cost = _perf.emit_program_cost(spec.name, compiled,
+                                       precision=spec.precision)
         return CompiledProgram(
             spec, compiled, source, round(time.perf_counter() - t0, 3),
             cost,

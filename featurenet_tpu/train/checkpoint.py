@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import shutil
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -110,36 +112,152 @@ def _corrupt_step_dir(root: str, step: int) -> None:
 
 
 class CheckpointManager:
+    """Double-buffered async saves: ``save()`` is the host-blocking
+    ENQUEUE only — a device-side snapshot of the state dropped into one
+    of two slots — and a background writer thread drains the slots
+    through Orbax (waiting out each async write, then landing that
+    step's checksum sidecar). The enqueue therefore never waits on the
+    previous async write; it blocks only when BOTH slots are full, which
+    bounds snapshot HBM at two state copies in steady state (a blocked
+    third save has already taken its own snapshot before the put
+    backpressures, so the transient worst case is three). Proven against
+    the
+    ``save_slow@save`` fault site: the injected filesystem latency lands
+    in the writer's ``checkpoint_write`` span while the ``checkpoint_
+    save`` enqueue span stays bounded (tests/test_slo.py).
+
+    **Multi-process worlds keep the previous lockstep enqueue.** Orbax's
+    ``save()`` coordinates across hosts (a sync-global-devices barrier —
+    a real collective over the mesh), and a collective launched from a
+    side thread runs CONCURRENTLY with the training step's collectives
+    on the main thread: two in-flight collectives with no cross-host
+    ordering wedge the mesh. Observed, not theorized — the elastic
+    shrink e2e's generation 0 hung to its stall verdict exactly this
+    way. So with ``jax.process_count() > 1`` the save stays on the
+    caller thread (Orbax's own async machinery still overlaps the write
+    with training; only the wait-out-the-previous-write latency stays on
+    the path, as before this change)."""
+
+    # Total snapshots in flight: 2 = the one the writer is writing plus
+    # one queued behind it — the next save's snapshot can be taken while
+    # the previous write is still in flight, and a third save blocks
+    # (backpressure) instead of pinning unbounded HBM. The queue's
+    # capacity is SLOTS - 1 because the writer HOLDS its slot for the
+    # whole write (it pops the item before writing; a maxsize of SLOTS
+    # would quietly admit a third live snapshot).
+    SNAPSHOT_SLOTS = 2
+
     def __init__(self, directory: str, keep: int = 3, config=None):
         self._dir = os.path.abspath(directory)
         self._config = config
         self._saves = 0
         self._restores = 0
-        # Steps whose async save has been enqueued but whose checksum
-        # sidecar is not yet written (it can only be computed once the
-        # background write finalizes — see _flush_checksums).
-        self._pending_sums: list[int] = []
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True, enable_async_checkpointing=True
             ),
         )
+        # The double-buffer: started lazily on the first save() so
+        # restore-only managers (eval, infer, warm starts) never spawn a
+        # thread. The writer owns every _mgr.save/wait_until_finished
+        # after that point; the foreground only touches the manager again
+        # once the queue is drained (wait/close join the queue first).
+        self._queue: queue.Queue = queue.Queue(
+            maxsize=self.SNAPSHOT_SLOTS - 1
+        )
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: Optional[BaseException] = None
+        # Lockstep-mode bookkeeping (multi-process worlds, see the class
+        # docstring): steps whose async save is enqueued but whose
+        # checksum sidecar awaits the write's finalization.
+        self._pending_sync: list[int] = []
 
-    def _flush_checksums(self) -> None:
-        """Write the checksum sidecar for every finalized pending step and
-        GC sidecars of steps Orbax has retired. Called after any
-        wait_until_finished — never on the save critical path."""
-        for step in self._pending_sums:
-            target = _step_dir(self._dir, step)
-            if target is None:
-                continue  # already GC'd by retention
+    def _ensure_writer(self) -> None:
+        if self._writer is None:
+            self._writer = threading.Thread(
+                target=self._write_loop, name="ckpt-writer", daemon=True
+            )
+            self._writer.start()
+
+    def _check_writer(self) -> None:
+        """Surface a background write failure at the next foreground
+        touch point (save/wait/close) — a failed write must never be
+        silent, and never later than the next save decision."""
+        err, self._writer_error = self._writer_error, None
+        if err is not None:
+            raise RuntimeError(
+                "background checkpoint write failed"
+            ) from err
+
+    def _write_loop(self) -> None:
+        """The background writer: one queued snapshot at a time through
+        Orbax — enqueue the async write, wait it out, then land the
+        step's checksum sidecar (or, for an injected checkpoint_corrupt,
+        truncate the finalized step and leave NO sidecar, the on-disk
+        shape of a crash mid-write)."""
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            step, payload, save_n, corrupt = item
             try:
-                with open(_checksum_path(self._dir, step), "w") as fh:
-                    json.dump(_dir_checksums(target), fh)
-            except OSError:
-                pass  # sidecar is belt-and-suspenders, never load-bearing
-        self._pending_sums = []
+                with obs.span("checkpoint_write", step=step):
+                    if faults.maybe_fail("save_slow", save=save_n):
+                        # Latency injection: a dragging filesystem /
+                        # serialization in the BACKGROUND write — off the
+                        # step path by construction now; the span proves
+                        # where the slowness went.
+                        time.sleep(faults.SLOW_SLEEP_S)
+                    self._mgr.save(step, args=ocp.args.StandardSave(payload))
+                    self._mgr.wait_until_finished()
+                    if corrupt:
+                        _corrupt_step_dir(self._dir, step)
+                    else:
+                        self._write_checksum(step)
+                        self._gc_checksums()
+            except BaseException as e:  # surfaced by _check_writer
+                # Keep the FIRST failure: a later write failing with a
+                # secondary error (the disk already full) must not bury
+                # the root cause the operator needs.
+                if self._writer_error is None:
+                    self._writer_error = e
+            finally:
+                self._queue.task_done()
+
+    def _drain(self) -> None:
+        """Foreground barrier: every queued snapshot written and
+        finalized (and, in lockstep mode, every pending sidecar landed).
+        After this the Orbax manager is idle, so the caller may touch it
+        directly."""
+        if self._writer is not None:
+            self._queue.join()
+        self._mgr.wait_until_finished()  # no-op once the writer drained
+        self._flush_sync()
+
+    def _flush_sync(self) -> None:
+        """Lockstep mode: sidecars for every pending finalized step."""
+        for step in self._pending_sync:
+            self._write_checksum(step)
+        if self._pending_sync:
+            self._gc_checksums()
+        self._pending_sync = []
+
+    def _write_checksum(self, step: int) -> None:
+        """Checksum sidecar for one FINALIZED step (writer-side; never on
+        the save critical path)."""
+        target = _step_dir(self._dir, step)
+        if target is None:
+            return  # already GC'd by retention
+        try:
+            with open(_checksum_path(self._dir, step), "w") as fh:
+                json.dump(_dir_checksums(target), fh)
+        except OSError:
+            pass  # sidecar is belt-and-suspenders, never load-bearing
+
+    def _gc_checksums(self) -> None:
+        """Prune sidecars of steps Orbax's retention has retired."""
         try:
             kept = {int(s) for s in self._mgr.all_steps()}
             for name in os.listdir(self._dir):
@@ -190,13 +308,14 @@ class CheckpointManager:
         self._config = None  # write once per manager
 
     def save(self, state: TrainState, step: Optional[int] = None) -> None:
+        """Enqueue an async save of ``state`` (the fp32 masters — what
+        every precision mode persists). Host-blocking work: the config
+        sidecar (once), a device-side snapshot, and a bounded slot
+        enqueue. The Orbax write — including waiting out the PREVIOUS
+        write — happens on the background writer, so this never sits on
+        the step path while an earlier save is still flushing."""
+        self._check_writer()
         step = int(state.step) if step is None else step
-        if self._pending_sums:
-            # The previous async save must finalize before its sidecar can
-            # be computed (Orbax serializes consecutive saves anyway, so
-            # this wait is not new latency on the step path).
-            self._mgr.wait_until_finished()
-            self._flush_checksums()
         self._write_config()
         payload = {
             "step": state.step,
@@ -215,32 +334,53 @@ class CheckpointManager:
         # nothing donates). A device-side copy stays inside jax's
         # dataflow, so the donation is ordered after it; the copy's own
         # buffers are never donated, so the writer's views stay valid.
+        # The snapshot is also what makes the double-buffer sound: each
+        # queued slot owns its own device buffers, independent of the
+        # live state AND of the other slot.
         import jax.numpy as jnp
 
         payload = jax.tree_util.tree_map(
             lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
             payload,
         )
-        # Async save: this span is the host-blocking enqueue only; the
-        # background write's completion is bounded by checkpoint_wait.
         self._saves += 1
+        # The corrupt decision is taken HERE (deterministic counter
+        # order) but applied once the write finalizes — truncating the
+        # step dir and skipping its sidecar, the on-disk shape of a
+        # crash landing mid-checkpoint.
+        corrupt = faults.maybe_fail("checkpoint_corrupt", save=self._saves)
+        if jax.process_count() > 1:
+            # Lockstep mode (see the class docstring): Orbax's save
+            # coordination is a cross-host collective and must stay on
+            # the thread that runs the training collectives.
+            self._save_lockstep(step, payload, self._saves, corrupt)
+            return
+        self._ensure_writer()
         with obs.span("checkpoint_save", step=step):
-            if faults.maybe_fail("save_slow", save=self._saves):
-                # Latency injection: a dragging filesystem/serialization
-                # stretching the host-blocking half of the save — the span
-                # wraps it, so the slowness lands attributed in the report
-                # instead of as unexplained "other" time.
+            # The bounded enqueue: blocks ONLY when both snapshot slots
+            # are still in flight (backpressure beats unbounded HBM).
+            self._queue.put((step, payload, self._saves, corrupt))
+
+    def _save_lockstep(self, step: int, payload, save_n: int,
+                       corrupt: bool) -> None:
+        """The multi-process save path — the pre-double-buffer behavior:
+        wait out the previous async write (Orbax serializes consecutive
+        saves anyway), enqueue on the caller thread, sidecars flushed at
+        the next finalization point."""
+        if self._pending_sync:
+            self._mgr.wait_until_finished()
+            self._flush_sync()
+        with obs.span("checkpoint_save", step=step):
+            if faults.maybe_fail("save_slow", save=save_n):
+                # In lockstep mode the latency injection lands where the
+                # latency itself does: on the save path, attributed.
                 time.sleep(faults.SLOW_SLEEP_S)
             self._mgr.save(step, args=ocp.args.StandardSave(payload))
-        self._pending_sums.append(step)
-        if faults.maybe_fail("checkpoint_corrupt", save=self._saves):
-            # Wait for the async write to finalize, then truncate the step
-            # dir — the on-disk shape of a crash landing mid-checkpoint.
-            # The sidecar deliberately has NOT been written yet (pending
-            # flush): a crash mid-write leaves no checksum either.
+        if corrupt:
             self._mgr.wait_until_finished()
             _corrupt_step_dir(self._dir, step)
-            self._pending_sums.remove(step)
+        else:
+            self._pending_sync.append(step)
 
     def restore(self, state: TrainState, step: Optional[int] = None,
                 cleanup: bool = False) -> TrainState:
@@ -263,6 +403,10 @@ class CheckpointManager:
         shared/foreign directory) must never destroy another run's
         checkpoints on what might be a transient read error.
         """
+        # A restore through a manager with writes still in flight must
+        # see them finalized (no-op for the usual restore-only manager).
+        self._drain()
+        self._check_writer()
         latest = step if step is not None else self._mgr.latest_step()
         if latest is None:
             raise FileNotFoundError("no checkpoint to restore")
@@ -357,14 +501,19 @@ class CheckpointManager:
 
     def wait(self) -> None:
         with obs.span("checkpoint_wait"):
-            self._mgr.wait_until_finished()
-        self._flush_checksums()
+            self._drain()
+        self._gc_checksums()
+        self._check_writer()
 
     def close(self) -> None:
         # A save() + close() caller (no wait()) must not leave its last
-        # step checksum-less: finalize the in-flight async save and flush
-        # sidecars while the manager can still answer all_steps().
-        if self._pending_sums:
-            self._mgr.wait_until_finished()
-            self._flush_checksums()
+        # step checksum-less: drain the writer (which lands sidecars per
+        # finalized step) while the manager can still answer all_steps().
+        self._drain()
+        if self._writer is not None:
+            self._queue.put(None)  # writer exits after the sentinel
+            self._writer.join(timeout=30.0)
+            self._writer = None
+        self._gc_checksums()
         self._mgr.close()
+        self._check_writer()

@@ -1,0 +1,99 @@
+"""Training precision policy: fp32 master weights, optional bf16 working step.
+
+The model has always COMPUTED bf16 (flax modules with ``dtype=bfloat16``
+cast their fp32 params per layer inside the forward), but the training
+state itself ran fp32 end to end: fp32 params into the step, per-layer
+bf16 casts as temporaries, fp32 gradient storage out of the backward,
+fp32 optimizer math. The ``bf16_master`` policy moves the cast to the
+step boundary instead:
+
+- the optimizer (and every checkpoint) holds **fp32 master params** —
+  the masters are what's persisted, so checkpoints restore bitwise
+  across precision modes;
+- the jitted train step casts ONE **bf16 working copy** of the params
+  and differentiates with respect to it — the forward runs the same
+  bf16 math it always did (minus the per-layer casts), and the backward
+  now stores the gradient tree in bf16 (half the gradient HBM);
+- the gradients are upcast to fp32 at the step boundary and the update
+  applies to the masters — optimizer accumulation never runs in bf16.
+
+``fp32`` is the identity policy: the masters ARE the working copy and
+no cast exists anywhere (the compiled step is unchanged). The policy
+name rides ``TrainState`` as static metadata (``state.precision``), so
+one ``make_train_step`` serves both modes and the runtime registry
+fingerprints the two executables apart (``runtime.registry``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# The accepted Config.train_precision values — Config.validate() and the
+# CLI's --train-precision choices both mirror this pair (the config-cli
+# lint rule cross-checks the surfaces).
+TRAIN_PRECISIONS = ("fp32", "bf16_master")
+
+
+def _cast_floating(tree, dtype):
+    """Cast the floating-point leaves of ``tree`` to ``dtype``; integer
+    leaves (and leaves already at ``dtype``) pass through untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dtype:
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One training precision mode: how master params become the working
+    copy the forward/backward sees, and how the resulting gradients come
+    back to master dtype for the optimizer."""
+
+    name: str
+    # Working-copy dtype name, or None = the masters are the working copy
+    # (no cast compiled anywhere — the fp32 identity policy).
+    working_dtype: Optional[str] = None
+
+    def working_params(self, params):
+        """The param tree the forward/backward differentiates: a bf16
+        cast of the fp32 masters under ``bf16_master``, the masters
+        verbatim under ``fp32``."""
+        if self.working_dtype is None:
+            return params
+        import jax.numpy as jnp
+
+        return _cast_floating(params, jnp.dtype(self.working_dtype))
+
+    def master_grads(self, grads):
+        """Gradients at master dtype: the bf16 gradient tree upcast to
+        fp32 at the step boundary (optimizer accumulation must never run
+        in bf16), or the grads verbatim under ``fp32``."""
+        if self.working_dtype is None:
+            return grads
+        import jax.numpy as jnp
+
+        return _cast_floating(grads, jnp.float32)
+
+
+POLICIES = {
+    "fp32": PrecisionPolicy("fp32", None),
+    "bf16_master": PrecisionPolicy("bf16_master", "bfloat16"),
+}
+
+
+def get_policy(name: str) -> PrecisionPolicy:
+    """The policy object for a ``Config.train_precision`` value; a typo
+    is refused here (and at config-validate time) rather than silently
+    training at the wrong precision."""
+    if name not in POLICIES:
+        raise ValueError(
+            f"unknown train precision {name!r}; one of "
+            f"{', '.join(TRAIN_PRECISIONS)}"
+        )
+    return POLICIES[name]

@@ -16,13 +16,31 @@ from flax import core, struct
 
 
 class TrainState(struct.PyTreeNode):
+    """``params`` are always the fp32 MASTERS — under the ``bf16_master``
+    training precision policy (``train/precision.py``) the jitted step
+    casts a bf16 working copy for forward/backward and applies the
+    (fp32-upcast) gradients back to these masters. ``precision`` is the
+    policy name, carried as static metadata so one step function serves
+    both modes and a checkpoint (which persists the masters, never the
+    working copy) restores bitwise into either."""
+
     step: jax.Array
     params: core.FrozenDict[str, Any]
     batch_stats: core.FrozenDict[str, Any]
     opt_state: optax.OptState
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    precision: str = struct.field(pytree_node=False, default="fp32")
+
+    @property
+    def policy(self):
+        """The ``PrecisionPolicy`` this state trains under."""
+        from featurenet_tpu.train.precision import get_policy
+
+        return get_policy(self.precision)
 
     def apply_gradients(self, *, grads, batch_stats):
+        """Apply ``grads`` (already at master dtype — the step upcasts
+        via ``policy.master_grads`` before calling here) to the masters."""
         updates, new_opt_state = self.tx.update(
             grads, self.opt_state, self.params
         )
@@ -39,13 +57,19 @@ def create_state(
     tx: optax.GradientTransformation,
     sample_input,
     rng: jax.Array,
+    precision: str = "fp32",
 ) -> TrainState:
     """Initialize model variables and optimizer state (host-side, un-jitted).
 
     Callers that want sharded init should wrap this in ``jax.jit`` with
     output shardings (see ``Trainer``) so XLA materializes params directly
-    into their mesh placement.
+    into their mesh placement. ``precision`` names the training precision
+    policy (``train/precision.py``); the initialized params are fp32
+    masters under every policy.
     """
+    from featurenet_tpu.train.precision import get_policy
+
+    get_policy(precision)  # refuse a typo'd policy before any device work
     variables = model.init({"params": rng}, sample_input, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", core.freeze({}))
@@ -55,6 +79,7 @@ def create_state(
         batch_stats=batch_stats,
         opt_state=tx.init(params),
         tx=tx,
+        precision=precision,
     )
 
 

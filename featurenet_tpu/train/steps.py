@@ -31,6 +31,7 @@ def unpack_voxels(packed: jax.Array) -> jax.Array:
     shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)  # packbits is MSB-first
     bits = (packed[..., None] >> shifts) & jnp.uint8(1)
     b, d, h, w8 = packed.shape
+    # lint: allow-precision(wire contract: the model input edge is fp32)
     return bits.reshape(b, d, h, w8 * 8, 1).astype(jnp.float32)
 
 
@@ -93,6 +94,7 @@ def segmentation_loss(
     - ``dice``: soft Dice alone (ablation arm).
     """
     per_voxel = optax.softmax_cross_entropy_with_integer_labels(logits, seg)
+    # lint: allow-precision(loss-land class weighting stays fp32)
     is_fg = (seg > 0).astype(jnp.float32)
     # Foreground voxels weighted so fg and bg contribute ~equally.
     fg_frac = is_fg.mean()
@@ -212,10 +214,20 @@ def make_train_step(
                 noise_rng, augment_noise, voxels.shape
             )
             voxels = jnp.abs(voxels - flip.astype(voxels.dtype))
+        # Precision policy (train/precision.py): differentiate with
+        # respect to the WORKING copy — under bf16_master that is a bf16
+        # cast of the fp32 masters compiled inside this step (the
+        # donated-buffer dataflow; the cast's output is a fresh buffer,
+        # never the donated masters), so the backward stores bf16
+        # gradients. They come back to fp32 at the step boundary and the
+        # update applies to the masters. Under fp32 both calls are the
+        # identity and this step compiles exactly as it always did.
+        policy = state.policy
         grads, (new_stats, metrics) = jax.grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, voxels, target,
-            dropout_rng
+            policy.working_params(state.params), state.batch_stats,
+            voxels, target, dropout_rng
         )
+        grads = policy.master_grads(grads)
         state = state.apply_gradients(grads=grads, batch_stats=new_stats)
         metrics["grad_norm"] = optax.global_norm(grads)
         return state, metrics
@@ -402,6 +414,7 @@ def make_eval_step(
             mask = jnp.ones(voxels.shape[0], jnp.float32)
         if task == "classify":
             pred = jnp.argmax(logits, axis=-1)
+            # lint: allow-precision(eval exact sums accumulate fp32)
             hit = (pred == batch["label"]).astype(jnp.float32)
             correct = (hit * mask).sum()
             loss = (
@@ -441,6 +454,7 @@ def make_eval_step(
         ).sum()
         voxels_per_sample = seg.shape[1] * seg.shape[2] * seg.shape[3]
         return {
+            # lint: allow-precision(eval exact sums accumulate fp32)
             "correct": ((pred == seg).astype(jnp.float32) * vmask).sum(),
             "loss_sum": loss,
             "count": mask.sum() * voxels_per_sample,

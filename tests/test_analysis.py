@@ -290,6 +290,53 @@ def test_hygiene_bare_except_and_thread_daemon(tmp_path):
     assert sorted(_checks(findings)) == ["bare_except", "thread_daemon"]
 
 
+def test_hygiene_fp32_cast_in_hot_step(tmp_path):
+    """fp32 casts inside the compiled train step (train/steps.py) must
+    be deliberate: unannotated .astype(jnp.float32) / jnp.float32(...)
+    are findings there, an allow-precision annotation clears them, and
+    the same casts in any other module are out of scope."""
+    _write(tmp_path, "train/steps.py", """\
+        import jax.numpy as jnp
+
+        def step(x, y):
+            a = x.astype(jnp.float32)
+            b = jnp.float32(y)
+            # lint: allow-precision(loss-land accumulates fp32)
+            c = y.astype(jnp.float32)
+            d = x.astype(jnp.bfloat16)  # narrowing is not the contract
+            return a, b, c, d
+    """)
+    _write(tmp_path, "other.py", """\
+        import jax.numpy as jnp
+
+        def fine(x):
+            return x.astype(jnp.float32)
+    """)
+    findings = run_lint(str(tmp_path), rules=["hygiene"])
+    assert [(f.check, f.line) for f in findings] == [
+        ("fp32_cast_in_hot_step", 4),
+        ("fp32_cast_in_hot_step", 5),
+    ]
+    assert all("train/steps.py" in f.path for f in findings)
+
+
+def test_config_cli_rule_covers_train_precision_pair():
+    """Satellite (ISSUE 10): the config-cli rule's parsed surfaces both
+    see the new train_precision flag/choices pair on the REAL package —
+    the CLI choices list and Config.validate()'s accepted set agree, so
+    a drift on either side becomes a choices_drift finding."""
+    from featurenet_tpu.analysis.lint import load_tree, package_root
+    from featurenet_tpu.analysis.rules import _cli_flags, _validate_sets
+
+    tree = load_tree(package_root())
+    flags = {d: choices for _, d, _, choices
+             in _cli_flags(tree.module("cli.py"))}
+    assert "train_precision" in flags
+    assert set(flags["train_precision"]) == {"fp32", "bf16_master"}
+    accepted = _validate_sets(tree.module("config.py"))
+    assert accepted["train_precision"][0] == {"fp32", "bf16_master"}
+
+
 # --- rule: config-cli --------------------------------------------------------
 
 def _fixture_config(extra_fields: str = "") -> str:

@@ -165,6 +165,28 @@ def test_clamp_model_axis():
         clamp_model_axis(0, 8)
 
 
+def test_tp_probe_localizes_dropout_divergence():
+    """The numerics-bisection probe (analysis/tp_probe.py, ISSUE 10) for
+    the two known-failing TP parity tests above: every eval-mode module
+    intermediate must match between the model=2 mesh and a single device,
+    train mode WITHOUT dropout must match to float noise, and the first
+    diverging stage must be the dropout mask — which
+    jax_threefry_partitionable=True closes (the recorded fix, deferred:
+    flipping it changes every seeded RNG stream in the suite)."""
+    from featurenet_tpu.analysis.tp_probe import probe
+
+    out = probe(resolution=16, batch=8, tolerance=1e-3)
+    rows = {r["stage"]: r["max_abs_diff"] for r in out["rows"]}
+    # Layer-by-layer: no eval-mode intermediate diverges.
+    eval_rows = {k: v for k, v in rows.items()
+                 if k.startswith("forward/eval")}
+    assert eval_rows and max(eval_rows.values()) <= 1e-3
+    assert rows["forward/train-no-dropout"] <= 1e-3
+    assert rows["forward/train-dropout"] > 1e-2  # the real divergence
+    assert out["verdict"]["first_divergence"] == "forward/train-dropout"
+    assert out["verdict"]["fixed_by_threefry_partitionable"] is True
+
+
 def test_trainer_clamps_nondividing_model_axis(capsys):
     """A preset whose mesh_model doesn't divide the device count starts
     anyway on the widest feasible axis (round-1: abc128 crashed on 1 chip)."""

@@ -173,6 +173,52 @@ def test_mfu_alert_rule_validates_and_rule_value_reads_median():
     assert agg.rule_value("mfu", __import__("time").perf_counter()) == 0.2
 
 
+def test_roofline_constants_single_source():
+    """Satellite (ISSUE 10): the TPU v5e roofline constants live ONCE in
+    obs.perf's peak tables; ops/flops.py and ops/profile_step.py derive
+    theirs from it — a spec correction can no longer land in one copy
+    and miss the others."""
+    from featurenet_tpu.obs.perf import (
+        PEAK_BYTES_PER_SEC_BY_KIND,
+        PEAK_FLOPS_BY_KIND,
+    )
+    from featurenet_tpu.ops import flops, profile_step
+
+    assert flops.PEAK_BF16_FLOPS == PEAK_FLOPS_BY_KIND["TPU v5e"]
+    assert profile_step.PEAK_BF16_TFLOPS == \
+        PEAK_FLOPS_BY_KIND["TPU v5e"] / 1e12
+    assert profile_step.HBM_GBPS == \
+        PEAK_BYTES_PER_SEC_BY_KIND["TPU v5e"] / 1e9
+    assert profile_step.RIDGE_FLOP_PER_BYTE == pytest.approx(
+        PEAK_FLOPS_BY_KIND["TPU v5e"]
+        / PEAK_BYTES_PER_SEC_BY_KIND["TPU v5e"]
+    )
+
+
+def test_program_cost_precision_attributed_in_report():
+    """The per-program perf table carries the executable's precision
+    label (fp32 / bf16_master / int8) so a precision-rung delta is
+    attributable to the program that ran it."""
+    from featurenet_tpu.obs.report import build_report, format_report
+
+    events = [
+        {"t": 1.0, "ev": "program_cost", "program": "train_step",
+         "device_kind": "TPU v5e", "precision": "bf16_master",
+         "flops": 1e12, "bytes": 1e9, "peak_bytes": 2e9,
+         "process_index": 0},
+    ]
+    rep = build_report(events)
+    assert rep["perf"]["programs"]["train_step"]["precision"] == \
+        "bf16_master"
+    assert "bf16_master" in format_report(rep)
+    # A legacy stream without the field renders the column as absent.
+    legacy = build_report([
+        {"t": 1.0, "ev": "program_cost", "program": "train_step",
+         "device_kind": "TPU v5e", "flops": 1e12, "process_index": 0},
+    ])
+    assert "precision" not in legacy["perf"]["programs"]["train_step"]
+
+
 # --- report / trace / follow plumbing over synthetic events ------------------
 
 def _synthetic_events(device_kind="TPU v5e"):
@@ -300,15 +346,26 @@ def test_perf_gate_keys_directions_and_lowered_pin_fails():
         "serve_mfu": 0.55,
         "hbm_peak_train_bytes": 2.0e9,
         "train_roofline": "compute-bound",  # non-numeric: never a gate
+        # The bf16-master training row (ISSUE 10) pins like its fp32
+        # siblings: throughput/MFU min, peak bytes max.
+        "train_sps_bf16_master": 18000.0,
+        "mfu_train_bf16_master": 0.45,
+        "hbm_peak_train_bytes_bf16_master": 1.8e9,
+        "train_roofline_bf16_master": "compute-bound",
     }
     vals = gates.bench_gate_values(summary)
-    for key in ("mfu_train", "serve_mfu", "hbm_peak_train_bytes"):
+    for key in ("mfu_train", "serve_mfu", "hbm_peak_train_bytes",
+                "train_sps_bf16_master", "mfu_train_bf16_master",
+                "hbm_peak_train_bytes_bf16_master"):
         assert key in gates.BENCH_GATE_KEYS and key in vals
     assert "train_roofline" not in vals
     baseline = gates.make_baseline(vals)
     assert baseline["gates"]["mfu_train"]["direction"] == "min"
     assert baseline["gates"]["serve_mfu"]["direction"] == "min"
     assert baseline["gates"]["hbm_peak_train_bytes"]["direction"] == "max"
+    assert baseline["gates"]["train_sps_bf16_master"]["direction"] == "min"
+    assert baseline["gates"]["hbm_peak_train_bytes_bf16_master"][
+        "direction"] == "max"
     res = gates.evaluate_gates({**vals, "mfu_train": 0.2}, baseline)
     assert "mfu_train" in res["failed"]
     res = gates.evaluate_gates(

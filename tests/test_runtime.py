@@ -232,6 +232,61 @@ def test_probe_reject_env_gate(tmp_path, monkeypatch, run_events):
         ExecutableCache(str(tmp_path / "x"), probe="yolo")
 
 
+def test_exec_cache_separates_train_precisions(tmp_path, run_events):
+    """Acceptance (ISSUE 10): the fp32 and bf16_master train executables
+    have IDENTICAL avals (fp32 masters in and out) — only the policy in
+    the fingerprint separates them. A bf16-master world must never load
+    an fp32 program: the cross-precision build is a fresh compile (its
+    own entry file, no stale-reject eviction), and each mode then hits
+    its OWN entry."""
+    cache_dir = str(tmp_path / "exec")
+    cfg32 = get_config("smoke16")
+    cfg16 = get_config("smoke16", train_precision="bf16_master")
+
+    p32 = Runtime(cfg32, cache=ExecutableCache(cache_dir)).build(
+        "train_step"
+    )
+    assert p32.source == "fresh" and p32.precision == "fp32"
+    p16 = Runtime(cfg16, cache=ExecutableCache(cache_dir)).build(
+        "train_step"
+    )
+    assert p16.source == "fresh" and p16.precision == "bf16_master"
+    # Both modes re-load their own entries — two files coexist.
+    assert Runtime(cfg32, cache=ExecutableCache(cache_dir)).build(
+        "train_step").source == "cache"
+    assert Runtime(cfg16, cache=ExecutableCache(cache_dir)).build(
+        "train_step").source == "cache"
+    entries = [f for f in os.listdir(cache_dir) if f.endswith(".jexec")]
+    assert len(entries) == 2
+    kinds = _cache_events(run_events())
+    # No cross-precision hit and no stale-fingerprint eviction anywhere:
+    # exactly two misses, two hits, two compiles, zero rejects.
+    assert sum(k[0] == "cache_miss" for k in kinds) == 2
+    assert sum(k[0] == "cache_hit" for k in kinds) == 2
+    assert sum(k[0] == "program_compile" for k in kinds) == 2
+    assert not [k for k in kinds if k[0] == "cache_reject"]
+
+
+def test_cli_programs_enumerates_precision_variants(capsys):
+    """`cli programs --train-precision bf16_master` lists the train
+    programs (init included — its compiled output treedef bakes the
+    policy) under the policy while serving/eval stay fp32/int8."""
+    from featurenet_tpu.cli import main
+
+    main(["programs", "--config", "smoke16",
+          "--train-precision", "bf16_master"])
+    rows = {r["program"]: r for r in (
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    )}
+    for name in ("init", "train_step", "multi_train_step",
+                 "hbm_train_step"):
+        assert rows[name]["precision"] == "bf16_master"
+    assert rows["eval_step"]["precision"] == "fp32"
+    assert rows["serve"]["precision"] == "fp32"
+    assert rows["serve_int8"]["precision"] == "int8"
+
+
 def test_no_cache_no_files(tmp_path):
     """Default config (no exec_cache_dir): nothing serialized anywhere."""
     cfg = get_config("smoke16")

@@ -403,11 +403,20 @@ def test_producer_slow_injects_latency_not_death(monkeypatch):
     it.close()
 
 
-def test_save_slow_injects_latency_into_the_save_span(tmp_path, monkeypatch):
+def test_save_slow_off_critical_path_double_buffered(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 10): save() under the save_slow@save fault no
+    longer stretches the step path. The double-buffered manager's
+    host-blocking enqueue (the checkpoint_save span) stays bounded even
+    while the PREVIOUS write is still dragging in flight — the injected
+    latency lands in the background writer's checkpoint_write span —
+    and the checksum sidecars still land for every finalized step."""
+    import os as _os
     import time as _time
 
-    monkeypatch.setattr(faults, "SLOW_SLEEP_S", 0.2)
-    faults.install("save_slow@save=1")
+    from featurenet_tpu.train.checkpoint import _checksum_path
+
+    monkeypatch.setattr(faults, "SLOW_SLEEP_S", 0.6)
+    faults.install("save_slow@save=2")
     run_dir = str(tmp_path / "run")
     obs.init_run(run_dir, process_index=0)
     cfg = get_config(
@@ -416,17 +425,39 @@ def test_save_slow_injects_latency_into_the_save_span(tmp_path, monkeypatch):
         checkpoint_dir=str(tmp_path / "ckpt"),
     )
     t = Trainer(cfg)
-    t0 = _time.perf_counter()
-    t.ckpt.save(t.state)
-    assert _time.perf_counter() - t0 >= 0.2
+    import jax.numpy as jnp
+
+    # Warm the save path once (config-sidecar write, the snapshot
+    # tree_map's first trace, writer-thread start): those are one-time
+    # costs of the FIRST save ever, not the previous-write-in-flight
+    # property under test — timing them made this assertion flaky.
+    t.ckpt.save(t.state, step=1)
     t.ckpt.wait()
+    t0 = _time.perf_counter()
+    t.ckpt.save(t.state.replace(step=jnp.asarray(2, jnp.int32)), step=2)
+    enq1 = _time.perf_counter() - t0
+    # Third save WHILE the step-2 write sleeps 0.6 s in the writer: the
+    # second snapshot slot absorbs it without waiting the write out.
+    t0 = _time.perf_counter()
+    t.ckpt.save(t.state.replace(step=jnp.asarray(3, jnp.int32)), step=3)
+    enq2 = _time.perf_counter() - t0
+    assert enq1 < 0.5 and enq2 < 0.5, (enq1, enq2)
+    t.ckpt.wait()
+    # Sidecars for every finalized step, written by the writer itself.
+    root = str(tmp_path / "ckpt")
+    for step in (1, 2, 3):
+        assert _os.path.exists(_checksum_path(root, step))
     t.ckpt.close()
     obs.close_run()
     events, _ = load_events(run_dir)
     saves = [e for e in events
              if e["ev"] == "span" and e["name"] == "checkpoint_save"]
-    # The sleep happened INSIDE the span: the slowness is attributed.
-    assert saves and saves[0]["dur_s"] >= 0.2
+    writes = [e for e in events
+              if e["ev"] == "span" and e["name"] == "checkpoint_write"]
+    # Every enqueue span is bounded; the slowness is ATTRIBUTED — it
+    # moved into step 2's checkpoint_write span, off the step path.
+    assert len(saves) == 3 and all(s["dur_s"] < 0.5 for s in saves)
+    assert writes and max(w["dur_s"] for w in writes) >= 0.6
 
 
 def test_latency_sites_in_dsl_and_registry():

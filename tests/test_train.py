@@ -196,6 +196,152 @@ def test_hbm_resident_seg_training(tmp_path):
     assert np.isfinite(last["loss"])
 
 
+def test_bf16_master_tracks_fp32_with_eval_parity(rng):
+    """Mixed-precision acceptance (ISSUE 10): the bf16_master policy —
+    fp32 masters in the optimizer, bf16 working copy + bf16 gradient
+    storage inside the step — must (a) start from the IDENTICAL first
+    loss (the forward math is unchanged; only gradient storage moved to
+    bf16), (b) track the fp32 loss trajectory within tolerance over a
+    short run, (c) converge to the same overfit plateau, and (d) pass
+    the int8-agreement-style prediction gate against the fp32 model on
+    the same inputs (paper target >= 96.7% stays the TPU-round bar)."""
+    batch = generate_batch(rng, 12, resolution=16)
+    cfg = get_config("smoke16", warmup_steps=5, total_steps=120,
+                     peak_lr=3e-3)
+    model = FeatureNet(arch=tiny_arch())  # production bf16 compute dtype
+    tx = make_optimizer(cfg)
+    step = jax.jit(make_train_step(model, "classify"), donate_argnums=(0,))
+    rng_key = jax.random.key(1)
+    runs = {}
+    for prec in ("fp32", "bf16_master"):
+        state = create_state(
+            model, tx, jnp.asarray(batch["voxels"]), jax.random.key(0),
+            precision=prec,
+        )
+        assert state.precision == prec
+        losses = []
+        for _ in range(100):
+            state, metrics = step(state, batch, rng_key)
+            losses.append(float(metrics["loss"]))
+        # Masters stay fp32 under every policy — they are what persists.
+        for leaf in jax.tree_util.tree_leaves(state.params):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                assert leaf.dtype == jnp.float32, leaf.dtype
+        runs[prec] = (losses, state)
+    l32, lbf = runs["fp32"][0], runs["bf16_master"][0]
+    assert l32[0] == pytest.approx(lbf[0], abs=1e-3)  # same forward math
+    # Trajectory tracking: bf16 gradient storage diverges slowly, never
+    # wildly (measured max |delta| ~0.28 mid-descent on this seed).
+    assert max(abs(a - b) for a, b in zip(l32, lbf)) < 0.6
+    assert l32[-1] < 0.2 and lbf[-1] < 0.2  # both overfit
+    # Eval gate, int8-agreement style: top-1 predictions of the two
+    # trained models agree on the training inputs at the paper bar.
+    def preds(state):
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.asarray(batch["voxels"]), train=False,
+        )
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    agreement = (preds(runs["fp32"][1])
+                 == preds(runs["bf16_master"][1])).mean()
+    assert agreement >= 0.967, f"cross-precision agreement {agreement}"
+
+
+def test_checkpoint_cross_precision_restore(tmp_path):
+    """Checkpoints persist the fp32 MASTERS under every precision policy,
+    so a bf16_master run's checkpoint restores BITWISE into an fp32 run
+    (and vice versa) — including the resume path when only the other
+    mode's checkpoints exist, and the corrupt-latest walk-back."""
+    def run_one(precision, ckpt_dir, total=2):
+        cfg = get_config(
+            "smoke16", train_precision=precision, total_steps=total,
+            checkpoint_every=1, eval_every=10**9, log_every=10**9,
+            data_workers=1, global_batch=8, eval_batches=1,
+            checkpoint_dir=str(ckpt_dir),
+        )
+        t = Trainer(cfg)
+        t.run()
+        return t
+
+    for src, dst in (("bf16_master", "fp32"), ("fp32", "bf16_master")):
+        ckpt = tmp_path / f"ckpt_{src}"
+        trained = run_one(src, ckpt)
+        cfg2 = get_config(
+            "smoke16", train_precision=dst, total_steps=2,
+            checkpoint_every=1, eval_every=10**9, log_every=10**9,
+            data_workers=1, global_batch=8, eval_batches=1,
+            checkpoint_dir=str(ckpt),
+        )
+        t2 = Trainer(cfg2)
+        assert t2.resume_if_available() == 2
+        assert t2.state.precision == dst  # policy is the run's, not disk's
+        for a, b in zip(jax.tree_util.tree_leaves(trained.state.params),
+                        jax.tree_util.tree_leaves(t2.state.params)):
+            assert np.asarray(a).dtype == np.float32
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Walk-back across precisions: truncate the latest (bf16_master-made)
+    # step; an fp32 resume must fall back cleanly to the previous one.
+    from featurenet_tpu.train.checkpoint import _step_dir
+
+    ckpt = tmp_path / "ckpt_bf16_master"
+    step2 = _step_dir(str(ckpt), 2)
+    assert step2 is not None
+    import os
+
+    for dirpath, _, files in os.walk(step2):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            with open(p, "r+b") as fh:
+                fh.truncate(os.path.getsize(p) // 2)
+    cfg3 = get_config(
+        "smoke16", train_precision="fp32", total_steps=2,
+        checkpoint_every=1, eval_every=10**9, log_every=10**9,
+        data_workers=1, global_batch=8, eval_batches=1,
+        checkpoint_dir=str(ckpt),
+    )
+    t3 = Trainer(cfg3)
+    assert t3.resume_if_available() == 1  # clean walk-back, wrong-mode disk
+
+
+def test_membytes_master_split_vs_measured_peak():
+    """Satellite (ISSUE 10): the HBM byte model knows the master/working
+    split — bf16_master costs masters(4)+working(2)+grads(2+4) vs fp32's
+    params(4)+grads(4) — and the analytic fused-step estimate brackets
+    the executable's own measured peak (conservative: the clamp must
+    over-, never under-estimate on the calibrated side)."""
+    from featurenet_tpu.ops.membytes import fused_step_bytes, state_bytes
+    from featurenet_tpu.runtime import Runtime
+    from featurenet_tpu.train.state import param_count
+
+    n = 1_000_000
+    assert state_bytes(n, "adamw", "fp32") == n * 16
+    assert state_bytes(n, "adamw", "bf16_master") == n * 20
+    assert state_bytes(n, "sgd", "bf16_master") == n * 16
+
+    measured = {}
+    for prec in ("fp32", "bf16_master"):
+        cfg = get_config("smoke16", train_precision=prec)
+        rt = Runtime(cfg, cache=None)
+        prog = rt.build("train_step")
+        params_n = param_count(rt.abstract_state.params)
+        est = fused_step_bytes(cfg, 1, params_n)
+        measured[prec] = (est, prog.cost.get("peak_bytes"))
+    est32, peak32 = measured["fp32"]
+    est16, peak16 = measured["bf16_master"]
+    # The split raises the analytic state term by exactly 4 bytes/param.
+    assert est16 - est32 == 4 * param_count(
+        Runtime(get_config("smoke16"), cache=None).abstract_state.params
+    )
+    if peak32 is None or peak16 is None:
+        pytest.skip("backend reports no memory analysis")
+    # First-order honesty band against XLA's own buffer assignment:
+    # conservative (>= measured) but within 4x of it, both precisions.
+    for est, peak in ((est32, peak32), (est16, peak16)):
+        assert peak <= est <= 4 * peak, (est, peak)
+
+
 def test_dispatch_k_membytes_model():
     """ops/membytes reproduces the measured round-4/5 dispatch decisions:
     the combined seg64 model cannot fuse dispatches (XLA memory_analysis
