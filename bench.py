@@ -291,16 +291,41 @@ def _measure_round(platform: str) -> dict:
         get_config("sprint64", train_precision="bf16_master"),
         batch_per_chip=cfg.global_batch, repeats=REPEATS,
     )
+    # fp16+loss-scaling arm (ISSUE 12): the same master/working split at
+    # float16 with dynamic loss scaling compiled into the step — the
+    # rung that matters on backends where fp16 is the fast path. Same
+    # session, same protocol, so the fp32 headline is the denominator.
+    fp16 = measure_train_step(
+        get_config("sprint64", train_precision="fp16_scaled"),
+        batch_per_chip=cfg.global_batch, repeats=REPEATS,
+    )
+    # Layout-specialized 3^3 conv stem (ops/conv33.py, the roofline's
+    # memory-bound lever): the flagship arch with its stride-1 3^3
+    # blocks lowered as tap-unrolled channels-last matmuls instead of
+    # XLA's generic conv. CPU numerics are pinned in tests; this row is
+    # what TPU round r06 pins (vs_xla is the payoff measurement).
+    import dataclasses as _dc
+
+    fused33 = measure_train_step(
+        _dc.replace(
+            cfg, arch=_dc.replace(cfg.arch, conv_backend="fused33")
+        ).validate(),
+        batch_per_chip=cfg.global_batch, repeats=REPEATS,
+    )
     wcfg = get_config("warp64")
     warp = measure_train_step(
         wcfg, batch_per_chip=wcfg.global_batch, repeats=REPEATS
     )
     paper = measure_train_step(get_config("pod64"), repeats=REPEATS)
     serving = measure_inference(cfg, repeats=REPEATS)
-    # int8 serving (runtime registry serve_packed_int8): ROADMAP item 2's
-    # remaining serving rung — per-channel weight-quantized executable,
-    # measured with the identical converged-slope protocol so the fp32 and
-    # int8 headlines are comparable within one session.
+    # Reduced-precision serving rungs, identical converged-slope
+    # protocol in the same session so the fp32 headline is the honest
+    # denominator for both: bf16 (serve_packed_bf16 — the working-copy
+    # cast compiled into the forward; serving is the traffic-dominant
+    # program under the million-user north star, and this is its first
+    # measured sub-fp32 rung with an agreement gate) and int8
+    # (serve_packed_int8, per-channel weight-quantized).
+    serving_bf16 = measure_inference(cfg, repeats=REPEATS, precision="bf16")
     serving_int8 = measure_inference(cfg, repeats=REPEATS, precision="int8")
     # Time-to-first-step through the persistent executable cache: cold
     # compiles and populates a throwaway cache, warm rebuilds through it.
@@ -468,6 +493,25 @@ def _measure_round(platform: str) -> dict:
         **{f"{k}_bf16_master": bf16[k] for k in
            ("mfu_train", "hbm_peak_train_bytes", "train_roofline")
            if k in bf16},
+        # The fp16+loss-scaling training row (same arch/batch/protocol;
+        # the third train_precision rung — vs_fp32 is its payoff).
+        "train_sps_fp16_scaled": fp16["samples_per_sec_per_chip"],
+        "train_fp16_scaled_spread_pct": fp16["spread_pct"],
+        "train_fp16_scaled_vs_fp32": round(
+            fp16["samples_per_sec_per_chip"]
+            / max(flag["samples_per_sec_per_chip"], 1e-9), 3
+        ),
+        **{f"{k}_fp16_scaled": fp16[k] for k in
+           ("mfu_train", "hbm_peak_train_bytes", "train_roofline")
+           if k in fp16},
+        # The layout-specialized 3^3 conv stem row (ops/conv33.py):
+        # the flagship under conv_backend=fused33, vs the XLA lowering.
+        "train_sps_fused33": fused33["samples_per_sec_per_chip"],
+        "train_fused33_spread_pct": fused33["spread_pct"],
+        "train_fused33_vs_xla": round(
+            fused33["samples_per_sec_per_chip"]
+            / max(flag["samples_per_sec_per_chip"], 1e-9), 3
+        ),
         **({"serve_mfu": serving["serve_mfu"]}
            if "serve_mfu" in serving else {}),
         "serving_inferences_per_sec_per_chip":
@@ -477,6 +521,18 @@ def _measure_round(platform: str) -> dict:
         "serving_spread_pct": serving["spread_pct"],
         "serving_spread_minmax_pct": serving["spread_minmax_pct"],
         "serving_repeats": serving["repeats"],
+        # bf16 serving rung (serve_packed_bf16): throughput, spread, the
+        # payoff ratio, and its own measured-cost MFU (serve_mfu_bf16 —
+        # the ladder's "did the cast buy bandwidth" evidence).
+        "serving_bf16_inferences_per_sec_per_chip":
+            serving_bf16["inferences_per_sec_per_chip"],
+        "serving_bf16_spread_pct": serving_bf16["spread_pct"],
+        "serving_bf16_vs_fp32": round(
+            serving_bf16["inferences_per_sec_per_chip"]
+            / max(serving["inferences_per_sec_per_chip"], 1e-9), 2
+        ),
+        **({"serve_mfu_bf16": serving_bf16["serve_mfu"]}
+           if "serve_mfu" in serving_bf16 else {}),
         "serving_int8_inferences_per_sec_per_chip":
             serving_int8["inferences_per_sec_per_chip"],
         "serving_int8_spread_pct": serving_int8["spread_pct"],
@@ -548,10 +604,16 @@ def _measure_round(platform: str) -> dict:
         ("mfu_train", 0.02),
         ("serve_mfu", 0.02),
         ("hbm_peak_train_bytes", 32.0 * 1024 * 1024),
-        # The bf16-master row's pins mirror its fp32 siblings.
+        # The reduced-precision rows' pins mirror their fp32 siblings.
         ("train_bf16_master_spread_pct", SPREAD_TOLERANCE_ABS),
         ("mfu_train_bf16_master", 0.02),
         ("hbm_peak_train_bytes_bf16_master", 32.0 * 1024 * 1024),
+        ("train_fp16_scaled_spread_pct", SPREAD_TOLERANCE_ABS),
+        ("mfu_train_fp16_scaled", 0.02),
+        ("hbm_peak_train_bytes_fp16_scaled", 32.0 * 1024 * 1024),
+        ("train_fused33_spread_pct", SPREAD_TOLERANCE_ABS),
+        ("serving_bf16_spread_pct", SPREAD_TOLERANCE_ABS),
+        ("serve_mfu_bf16", 0.02),
         ("window_data_wait_p50_ms", 1.0),
         ("window_data_wait_p99_ms", 5.0),
         ("window_queue_depth_p50", 1.0),

@@ -320,26 +320,35 @@ def _scope_nodes(scope: ast.AST):
             stack.extend(ast.iter_child_nodes(node))
 
 
-# Modules whose compiled train step is under the precision-cast
-# contract: with the bf16_master policy (train/precision.py) every fp32
-# cast inside the hot step is a numerics decision — a stray one silently
-# re-widens part of the working step back to fp32, eating the rung's
-# win without failing anything. Deliberate casts carry
-# ``# lint: allow-precision(<why fp32 here>)``.
-PRECISION_CAST_MODULES = ("train/steps.py",)
+# Modules whose compiled step / serving hot path is under the
+# precision-cast contract: with the reduced-precision policies
+# (train/precision.py — bf16_master/fp16_scaled training, bf16/int8
+# serving) every fp32 cast in these paths is a numerics decision — a
+# stray one silently re-widens part of the working step (or the serving
+# forward's input/readback edge) back to fp32, eating the rung's win
+# without failing anything. Deliberate casts carry
+# ``# lint: allow-precision(<why fp32 here>)``. The serve modules
+# joined with the serve-precision ladder (ISSUE 12): infer.py and the
+# service own the request edges the bf16/int8 programs consume.
+PRECISION_CAST_MODULES = ("train/steps.py", "infer.py",
+                         "serve/batcher.py", "serve/service.py")
 
 
 def _is_fp32_cast(node: ast.Call) -> Optional[str]:
-    """The human name of an fp32-cast construct, or None."""
+    """The human name of an fp32-cast construct, or None. Both array
+    namespaces count: ``jnp`` casts re-widen the compiled step,
+    ``np`` casts re-widen the serving host edges."""
     f = node.func
     if (isinstance(f, ast.Attribute) and f.attr == "astype" and node.args):
         a = node.args[0]
         if (isinstance(a, ast.Attribute) and a.attr == "float32"
-                and isinstance(a.value, ast.Name) and a.value.id == "jnp"):
-            return ".astype(jnp.float32)"
+                and isinstance(a.value, ast.Name)
+                and a.value.id in ("jnp", "np", "numpy")):
+            return f".astype({a.value.id}.float32)"
     if (isinstance(f, ast.Attribute) and f.attr == "float32"
-            and isinstance(f.value, ast.Name) and f.value.id == "jnp"):
-        return "jnp.float32(...)"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("jnp", "np", "numpy")):
+        return f"{f.value.id}.float32(...)"
     return None
 
 
@@ -573,10 +582,28 @@ def _cli_flags(mod: Module) -> list[tuple[str, str, int, Optional[tuple]]]:
     return flags
 
 
+def _self_rooted_attr(node: ast.AST) -> Optional[str]:
+    """The trailing attribute name of a ``self``-rooted attribute chain
+    (``self.X`` → ``"X"``, ``self.arch.X`` → ``"X"``), or None. Nested
+    chains matter because sub-config fields (``arch.conv_backend``) are
+    validated through the parent's ``validate()`` but reached by their
+    OWN aliased CLI flag (``--conv-backend``)."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    inner = node.value
+    while isinstance(inner, ast.Attribute):
+        inner = inner.value
+    if isinstance(inner, ast.Name) and inner.id == "self":
+        return node.attr
+    return None
+
+
 def _validate_sets(cfg_mod: Module) -> dict[str, tuple[set, int]]:
     """Field -> (accepted literal set, line) for every membership refusal
-    in ``Config.validate()`` — the ``self.X not in ("a", "b")`` guards the
-    CLI's ``choices=`` lists must agree with."""
+    in ``Config.validate()`` — the ``self.X not in ("a", "b")`` (or
+    ``self.arch.X not in (...)``) guards the CLI's ``choices=`` lists
+    must agree with. Nested chains are keyed by the trailing attribute,
+    matching the aliased flag's dest."""
     out: dict[str, tuple[set, int]] = {}
     for node in ast.walk(cfg_mod.tree):
         if not (isinstance(node, ast.ClassDef) and node.name == "Config"):
@@ -589,11 +616,11 @@ def _validate_sets(cfg_mod: Module) -> dict[str, tuple[set, int]]:
                 if not (isinstance(cmp, ast.Compare)
                         and len(cmp.ops) == 1
                         and isinstance(cmp.ops[0], ast.NotIn)
-                        and isinstance(cmp.left, ast.Attribute)
-                        and isinstance(cmp.left.value, ast.Name)
-                        and cmp.left.value.id == "self"
                         and isinstance(cmp.comparators[0],
                                        (ast.Tuple, ast.List, ast.Set))):
+                    continue
+                field = _self_rooted_attr(cmp.left)
+                if field is None:
                     continue
                 values = {
                     e.value for e in cmp.comparators[0].elts
@@ -601,7 +628,7 @@ def _validate_sets(cfg_mod: Module) -> dict[str, tuple[set, int]]:
                     and isinstance(e.value, str)
                 }
                 if values:
-                    out[cmp.left.attr] = (values, cmp.lineno)
+                    out[field] = (values, cmp.lineno)
     return out
 
 
@@ -655,9 +682,13 @@ def config_cli_rule(tree: Tree) -> list[Finding]:
     # other.
     accepted = _validate_sets(cfg_mod)
     for flag, dest, line, choices in flags:
-        if dest not in fields:
-            continue  # aliased flags narrow arch subfields, not Config
         acc = accepted.get(dest)
+        if dest not in fields and acc is None:
+            # Aliased flags without a validate-set contract (world
+            # shape, supervision policy) have no choices to mirror; an
+            # aliased flag whose trailing field IS restricted (e.g.
+            # --conv-backend vs self.arch.conv_backend) stays checked.
+            continue
         if choices is not None and acc is not None \
                 and set(choices) != acc[0]:
             findings.append(Finding(

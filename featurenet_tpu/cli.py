@@ -34,15 +34,32 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--seed", type=int)
     p.add_argument("--grad-clip", type=float, dest="grad_clip",
                    help="global-norm gradient clipping (0 = off)")
-    p.add_argument("--train-precision", choices=["fp32", "bf16_master"],
+    p.add_argument("--train-precision",
+                   choices=["fp32", "bf16_master", "fp16_scaled"],
                    dest="train_precision",
                    help="training precision policy "
                         "(featurenet_tpu.train.precision): bf16_master "
                         "keeps fp32 master weights in the optimizer while "
                         "the compiled step runs a bf16 working copy "
-                        "(bf16 gradient storage, fp32 update); masters "
-                        "are what checkpoints persist, so modes restore "
-                        "into each other (default fp32)")
+                        "(bf16 gradient storage, fp32 update); "
+                        "fp16_scaled is the same split at float16 plus "
+                        "dynamic loss scaling (non-finite grads skip the "
+                        "update bitwise and halve the scale; the scale "
+                        "state rides the checkpoint); masters are what "
+                        "checkpoints persist, so modes restore into each "
+                        "other (default fp32)")
+    p.add_argument("--serve-precision", choices=["fp32", "bf16", "int8"],
+                   dest="serve_precision",
+                   help="serving/eval precision policy "
+                        "(featurenet_tpu.train.precision): bf16 serves "
+                        "a bfloat16 working copy of the fp32 masters — "
+                        "cast once at startup, so every serve/"
+                        "serve_packed dispatch reads 2-byte weights; "
+                        "eval_step compiles the same cast inside for "
+                        "accuracy-faithful eval; int8 selects the "
+                        "per-channel quantized programs; each rung is "
+                        "gated by the precision-agnostic agreement "
+                        "check at the paper's 96.7%% bar (default fp32)")
     p.add_argument("--checkpoint-dir")
     p.add_argument("--mesh-model", type=int)
     p.add_argument("--data-workers", type=int)
@@ -99,8 +116,11 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="use the direct strided conv instead of the "
                         "space-to-depth stem (matches checkpoints trained "
                         "with stem_s2d=False)")
-    p.add_argument("--conv-backend", choices=["xla", "pallas", "hybrid_dw"],
-                   help="backend for stride-1 conv blocks (default xla)")
+    p.add_argument("--conv-backend",
+                   choices=["xla", "pallas", "hybrid_dw", "fused33"],
+                   help="backend for stride-1 conv blocks (default xla); "
+                        "fused33 is the layout-specialized tap-unrolled "
+                        "path for the 3^3 blocks (ops/conv33.py)")
     p.add_argument("--seg-loss", choices=["balanced_ce", "ce_dice", "dice"],
                    help="segmentation loss variant (default balanced_ce)")
     p.add_argument("--seg-input-context",
@@ -217,7 +237,7 @@ def _overrides(args) -> dict:
         "checkpoint_dir", "mesh_model", "data_workers", "data_cache",
         "profile_dir", "tb_dir", "run_dir", "heartbeat_file", "seg_loss",
         "restart_every_steps", "steps_per_dispatch", "grad_clip",
-        "train_precision",
+        "train_precision", "serve_precision",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
         "augment_translate_vox", "init_from", "inject_faults",
         "alert_rules", "exec_cache_dir", "min_world_size",
@@ -452,12 +472,19 @@ def main(argv=None) -> None:
                         help="preset whose program catalog to list "
                              "(default pod64)")
     p_prog.add_argument("--train-precision",
-                        choices=["fp32", "bf16_master"],
+                        choices=["fp32", "bf16_master", "fp16_scaled"],
                         dest="train_precision",
                         help="enumerate (and --warm build) the train "
                              "programs under this precision policy; the "
                              "executable-cache fingerprint separates the "
-                             "two variants (default fp32)")
+                             "variants (default fp32)")
+    p_prog.add_argument("--serve-precision",
+                        choices=["fp32", "bf16", "int8"],
+                        dest="serve_precision",
+                        help="enumerate (and --warm build) eval_step "
+                             "under this serving precision (the serve/"
+                             "serve_bf16/serve_int8 variants are listed "
+                             "by name regardless; default fp32)")
     p_prog.add_argument("--warm", action="store_true",
                         help="build every applicable program (AOT warmup; "
                              "with --exec-cache-dir, populates the "
@@ -541,16 +568,22 @@ def main(argv=None) -> None:
     p_inf.add_argument("--no-stem-s2d", action="store_true",
                        help="legacy checkpoints trained with "
                             "--no-stem-s2d (param tree differs)")
-    p_inf.add_argument("--conv-backend", choices=["xla", "pallas", "hybrid_dw"],
+    p_inf.add_argument("--conv-backend",
+                       choices=["xla", "pallas", "hybrid_dw", "fused33"],
                        help="legacy checkpoints trained with a non-default "
                             "conv backend")
-    p_inf.add_argument("--precision", choices=["fp32", "int8"],
-                       default="fp32",
-                       help="serving weight precision: int8 runs the "
+    p_inf.add_argument("--precision", choices=["fp32", "bf16", "int8"],
+                       default=None,
+                       help="serving weight precision (default: the "
+                            "config's serve_precision, itself fp32 by "
+                            "default): bf16 serves a bfloat16 working "
+                            "copy cast once at startup (half the weight "
+                            "HBM traffic per dispatch); int8 runs the "
                             "per-channel post-training-quantized program "
                             "(featurenet_tpu.runtime.quantize; 4x less "
-                            "weight HBM traffic, accuracy-gated in tests "
-                            "against the paper's 96.7%% target)")
+                            "weight HBM traffic); both rungs are "
+                            "accuracy-gated in tests against the "
+                            "paper's 96.7%% target")
     p_inf.add_argument("--seg-out",
                        help="segment checkpoints: also write each part's "
                             "per-voxel label grid to this directory as "
@@ -579,9 +612,10 @@ def main(argv=None) -> None:
     p_srv.add_argument("--config", default=None,
                        help="only needed for legacy checkpoints without a "
                             "persisted config.json")
-    p_srv.add_argument("--precision", choices=["fp32", "int8"],
-                       default="fp32",
-                       help="serving weight precision (see `infer`)")
+    p_srv.add_argument("--precision", choices=["fp32", "bf16", "int8"],
+                       default=None,
+                       help="serving weight precision (see `infer`; "
+                            "default: the config's serve_precision)")
     p_srv.add_argument("--buckets", default="1,4,16,64",
                        help="comma list of compiled batch shapes (the "
                             "bucket ladder); every one is built AOT at "
@@ -646,6 +680,8 @@ def main(argv=None) -> None:
             prog_over["exec_cache_dir"] = args.exec_cache_dir
         if getattr(args, "train_precision", None):
             prog_over["train_precision"] = args.train_precision
+        if getattr(args, "serve_precision", None):
+            prog_over["serve_precision"] = args.serve_precision
         cfg = get_config(args.config, **prog_over)
         if args.run_dir:
             from featurenet_tpu import obs
@@ -1325,7 +1361,7 @@ def main(argv=None) -> None:
         print(json.dumps({"serving": {
             "host": srv.server_address[0], "port": srv.server_address[1],
             "buckets": list(buckets), "max_wait_ms": args.max_wait_ms,
-            "queue_limit": args.queue_limit, "precision": args.precision,
+            "queue_limit": args.queue_limit, "precision": pred.precision,
             "endpoints": ["POST /predict", "GET /stats"],
         }}), flush=True)
         stop = threading.Event()

@@ -105,15 +105,33 @@ class Config:
     # collapses — BASELINE.md round-3/4 recipe study).
     grad_clip: float = 0.0
     # Training precision policy (train/precision.py): "fp32" (the
-    # identity — fp32 params through the step, unchanged executable) or
+    # identity — fp32 params through the step, unchanged executable),
     # "bf16_master" (the optimizer holds fp32 master weights while the
     # jitted step casts a bf16 working copy for forward/backward, stores
-    # bf16 gradients, and upcasts them to fp32 for the update). Masters
-    # are what checkpoints persist, so a checkpoint restores bitwise
-    # across modes; the runtime registry fingerprints the two train
+    # bf16 gradients, and upcasts them to fp32 for the update), or
+    # "fp16_scaled" (the same master/working split at float16, plus
+    # dynamic loss scaling: the scale doubles after N clean steps and a
+    # non-finite gradient tree halves it and skips the update bitwise —
+    # the skip/scale state rides TrainState, so checkpoints restore it).
+    # Masters are what checkpoints persist, so a checkpoint restores
+    # bitwise across modes; the runtime registry fingerprints the train
     # executables apart (a bf16-master world never loads an fp32
     # program). Run policy, not identity.
     train_precision: str = "fp32"
+    # Serving/eval precision policy (the inference half of the ladder —
+    # train/precision.serve_params_cast): "fp32" (identity), "bf16"
+    # (the serve/serve_packed programs take a bf16 working copy cast
+    # once at Predictor construction — half the weight reads per
+    # dispatch; eval_step compiles the cast inside for accuracy-faithful
+    # eval; masters and BN stats stay fp32), or "int8" (the per-channel
+    # weight-quantized programs, runtime/quantize.py). Selects which
+    # serving catalog programs the Predictor/InferenceService build and
+    # which cast eval_step compiles; the precision lands in every
+    # ProgramSpec and the exec-cache fingerprint exactly as
+    # train_precision does, so cross-precision cache hits stay
+    # impossible. Every reduced rung is gated by the precision-agnostic
+    # agreement check at the paper's 96.7% bar. Run policy, not identity.
+    serve_precision: str = "fp32"
 
     # Parallelism (mesh axis sizes; None = use all available devices on data).
     mesh_data: Optional[int] = None
@@ -282,13 +300,30 @@ class Config:
             _rules(self.alert_rules)
         if self.seg_loss not in ("balanced_ce", "ce_dice", "dice"):
             raise ValueError(f"unknown seg_loss {self.seg_loss!r}")
-        if self.train_precision not in ("fp32", "bf16_master"):
+        if self.train_precision not in ("fp32", "bf16_master",
+                                        "fp16_scaled"):
             # Literal set mirrored by the CLI's --train-precision choices
             # and train.precision.TRAIN_PRECISIONS (the config-cli lint
             # rule cross-checks the CLI surface against this guard).
             raise ValueError(
                 f"unknown train_precision {self.train_precision!r}; one "
-                "of fp32, bf16_master"
+                "of fp32, bf16_master, fp16_scaled"
+            )
+        if self.serve_precision not in ("fp32", "bf16", "int8"):
+            # Mirrored by --serve-precision / --precision choices and
+            # train.precision.SERVE_PRECISIONS (config-cli lint checks).
+            raise ValueError(
+                f"unknown serve_precision {self.serve_precision!r}; one "
+                "of fp32, bf16, int8"
+            )
+        if self.arch.conv_backend not in ("xla", "pallas", "hybrid_dw",
+                                          "fused33"):
+            # Mirrored by the CLI's --conv-backend choices. An unknown
+            # backend would otherwise silently fall through ConvBNRelu's
+            # else-branch and run XLA under the wrong label.
+            raise ValueError(
+                f"unknown arch.conv_backend {self.arch.conv_backend!r}; "
+                "one of xla, pallas, hybrid_dw, fused33"
             )
         if self.seg_input_context not in ("none", "proj", "proj_coords"):
             raise ValueError(

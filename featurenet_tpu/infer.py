@@ -62,19 +62,27 @@ class Predictor:
     the executable from the persistent cache instead of recompiling, and
     the first request never pays XLA.
 
-    ``precision="int8"`` serves per-channel weight-quantized int8 weights
+    ``precision`` is the serving weight precision (default: the config's
+    ``serve_precision``): ``"bf16"`` serves the ``serve_bf16`` program —
+    a bf16 working copy of the fp32 masters, cast ONCE at construction,
+    is what the program's avals name and what HBM serves per dispatch
+    (half the weight reads; masters/BN stats stay fp32) — and ``"int8"``
+    serves per-channel weight-quantized int8 weights
     (``runtime.quantize``): 4x less weight HBM traffic, dequantized on
-    device inside the program. Gate it with ``int8_agreement()`` — the
-    CPU-testable stand-in for the held-out accuracy target.
+    device inside the program. Gate any reduced rung with
+    ``agreement()`` — the precision-agnostic, CPU-testable stand-in for
+    the held-out accuracy target (paper bar 96.7%).
     """
 
     def __init__(self, params, batch_stats, cfg: Config, batch: int = 32,
-                 precision: str = "fp32"):
+                 precision: str | None = None):
         from featurenet_tpu.runtime import Runtime
         from featurenet_tpu.runtime.registry import PRECISIONS
 
         import jax
 
+        if precision is None:
+            precision = cfg.serve_precision
         if precision not in PRECISIONS:
             raise ValueError(
                 f"unknown serving precision {precision!r}; one of "
@@ -104,6 +112,17 @@ class Predictor:
             # Quantize once at construction; the program dequantizes on
             # device, so int8 is what sits in serving HBM.
             self._qparams, self._scales = quantize_tree(self._params)
+        # The tree the serve program reads per dispatch: the fp32
+        # masters under fp32, a bf16 WORKING COPY cast once HERE under
+        # bf16 — so 2-byte weights are what the program's avals name and
+        # what HBM serves on every request (the int8 path's
+        # transform-at-construction pattern; masters stay fp32 beside it
+        # for the agreement gate and re-precision).
+        self._serve_params = self._params
+        if precision == "bf16":
+            from featurenet_tpu.train.precision import serve_params_cast
+
+            self._serve_params = serve_params_cast(self._params, "bf16")
         # One executable per compile batch, memoized: the batch-mode API
         # uses exactly one (``batch``), the serving front end
         # (featurenet_tpu.serve) warms one per bucket in its ladder.
@@ -117,15 +136,19 @@ class Predictor:
         self._peaks = _perf.local_device_peaks()
 
     def program_for(self, batch: int):
-        """The ``serve``/``serve_int8`` executable at this compile batch,
-        built AOT through the runtime registry and memoized. Building one
-        per bucket at startup is the serving warmup — afterwards no
-        request shape ever triggers a compile."""
+        """The ``serve``/``serve_bf16``/``serve_int8`` executable at this
+        compile batch (``registry.serve_program_name`` — the one
+        precision→program mapping), built AOT through the runtime
+        registry and memoized. Building one per bucket at startup is the
+        serving warmup — afterwards no request shape ever triggers a
+        compile."""
+        from featurenet_tpu.runtime.registry import serve_program_name
+
         batch = int(batch)
         prog = self._programs.get(batch)
         if prog is None:
-            name = "serve_int8" if self.precision == "int8" else "serve"
-            prog = self.rt.build(name, batch=batch)
+            prog = self.rt.build(serve_program_name(self.precision),
+                                 batch=batch)
             self._programs[batch] = prog
         return prog
 
@@ -139,22 +162,37 @@ class Predictor:
         )
         if self.precision == "int8":
             return prog(self._qparams, self._scales, self._stats, voxels)
-        return prog(self._params, self._stats, voxels)
+        return prog(self._serve_params, self._stats, voxels)
 
     def _forward(self, voxels):
         return self.forward_padded(voxels, self.batch)
 
-    def int8_agreement(self, n: int = 48, seed: int = 0) -> float:
-        """Top-1 agreement between the fp32 and int8 forwards on fresh
-        synthetic parts — the serving-side accuracy gate (a prediction the
-        quantizer did not flip cannot have moved held-out accuracy)."""
+    def agreement(self, n: int = 48, seed: int = 0,
+                  reference_precision: str = "fp32",
+                  candidate_precision: str | None = None) -> float:
+        """Top-1 agreement between two serving precisions of this
+        checkpoint's weights on fresh synthetic parts — the
+        precision-agnostic serving accuracy gate
+        (``runtime.quantize.agreement``; a prediction the precision
+        change did not flip cannot have moved held-out accuracy).
+        ``candidate_precision`` defaults to THIS Predictor's precision,
+        so ``Predictor(..., precision="bf16").agreement()`` is the bf16
+        rung's gate and the int8 one reads the same way."""
         from featurenet_tpu.data.synthetic import generate_batch
         from featurenet_tpu.runtime.quantize import agreement
 
         grids = generate_batch(
             np.random.default_rng(seed), n, self.cfg.resolution
         )["voxels"]
-        return agreement(self.model, self._params, self._stats, grids)
+        return agreement(
+            self.model, self._params, self._stats, grids,
+            reference_precision=reference_precision,
+            candidate_precision=candidate_precision or self.precision,
+        )
+
+    def int8_agreement(self, n: int = 48, seed: int = 0) -> float:
+        """Back-compat alias: the int8 rung of ``agreement()``."""
+        return self.agreement(n=n, seed=seed, candidate_precision="int8")
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -163,7 +201,7 @@ class Predictor:
         checkpoint_dir: str,
         config: Config | str | None = None,
         batch: int = 32,
-        precision: str = "fp32",
+        precision: str | None = None,
     ) -> "Predictor":
         """Restore params/batch_stats from an Orbax run directory.
 
@@ -253,6 +291,7 @@ class Predictor:
             )
 
             g = np.stack([
+                # lint: allow-precision(wire contract: serve input edge is fp32)
                 _canon(g[i, ..., 0] > 0.5).astype(np.float32)
                 for i in range(n)
             ])[..., None]

@@ -152,6 +152,21 @@ class ConvBNRelu(nn.Module):
             from featurenet_tpu.ops.conv3d import HybridConv
 
             x = HybridConv(self.features, self.kernel, dtype=self.dtype)(x)
+        elif (self.stride == 1 and self.conv_backend == "fused33"
+                and self.kernel == 3):
+            # Layout-specialized 3^3 path (ops/conv33.py): tap-unrolled
+            # channels-last matmuls. Non-3^3 stride-1 blocks under the
+            # same backend fall through to nn.Conv below — the
+            # specialization is per-shape, not per-network. The explicit
+            # name pins the param scope to nn.Conv's auto-name, so the
+            # param TREE (not just the leaf shapes) matches the xla
+            # backend's and a checkpoint restores under either — the
+            # A/B-one-trained-run use the conv_backend identity
+            # exemption exists for.
+            from featurenet_tpu.ops.conv33 import Fused33Conv
+
+            x = Fused33Conv(self.features, dtype=self.dtype,
+                            name="Conv_0")(x)
         else:
             x = nn.Conv(
                 self.features,

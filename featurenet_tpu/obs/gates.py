@@ -71,23 +71,39 @@ DIRECTIONS = {
     "mfu_train": "min",
     "serve_mfu": "min",
     "hbm_peak_train_bytes": "max",
-    # Mixed-precision training rung (Config.train_precision): the
-    # bf16-master row regresses like its fp32 siblings — throughput/MFU
-    # downward, compiled peak memory and measurement spread upward.
+    # Mixed-precision training rungs (Config.train_precision): the
+    # bf16-master and fp16+loss-scaling rows regress like their fp32
+    # siblings — throughput/MFU downward, compiled peak memory and
+    # measurement spread upward.
     "train_sps_bf16_master": "min",
     "train_bf16_master_spread_pct": "max",
     "mfu_train_bf16_master": "min",
     "hbm_peak_train_bytes_bf16_master": "max",
+    "train_sps_fp16_scaled": "min",
+    "train_fp16_scaled_spread_pct": "max",
+    "mfu_train_fp16_scaled": "min",
+    "hbm_peak_train_bytes_fp16_scaled": "max",
+    # Layout-specialized 3^3 conv stem (arch.conv_backend="fused33",
+    # ops/conv33.py): the flagship measured under the tap-unrolled
+    # lowering — a regression here is the specialization rotting
+    # against XLA upgrades.
+    "train_sps_fused33": "min",
+    "train_fused33_spread_pct": "max",
     "e2e_samples_per_sec": "min",
     "e2e_pipelined_samples_per_sec": "min",
     "e2e_hbm_samples_per_sec": "min",
     "spread_pct": "max",
     "serving_spread_pct": "max",
-    # int8 serving throughput (runtime registry's serve_packed_int8) and
-    # time-to-first-step through the persistent executable cache: cold =
-    # fresh XLA compile, warm = guarded cache load. Both TTFS keys
-    # regress UPWARD — a warm start creeping back toward cold means the
-    # cache stopped serving (rejects, fingerprint churn).
+    # Reduced-precision serving throughput (serve_packed_bf16 /
+    # serve_packed_int8 — the serving rungs of the precision ladder,
+    # each agreement-gated at the paper's 96.7%) and time-to-first-step
+    # through the persistent executable cache: cold = fresh XLA compile,
+    # warm = guarded cache load. Both TTFS keys regress UPWARD — a warm
+    # start creeping back toward cold means the cache stopped serving
+    # (rejects, fingerprint churn).
+    "serving_bf16_inferences_per_sec_per_chip": "min",
+    "serving_bf16_spread_pct": "max",
+    "serve_mfu_bf16": "min",
     "serving_int8_inferences_per_sec_per_chip": "min",
     "serving_int8_spread_pct": "max",
     "ttfs_cold_s": "max",
@@ -184,6 +200,9 @@ BENCH_GATE_KEYS = (
     "e2e_hbm_samples_per_sec",
     "spread_pct",
     "serving_spread_pct",
+    "serving_bf16_inferences_per_sec_per_chip",
+    "serving_bf16_spread_pct",
+    "serve_mfu_bf16",
     "serving_int8_inferences_per_sec_per_chip",
     "serving_int8_spread_pct",
     "ttfs_cold_s",
@@ -195,6 +214,12 @@ BENCH_GATE_KEYS = (
     "train_bf16_master_spread_pct",
     "mfu_train_bf16_master",
     "hbm_peak_train_bytes_bf16_master",
+    "train_sps_fp16_scaled",
+    "train_fp16_scaled_spread_pct",
+    "mfu_train_fp16_scaled",
+    "hbm_peak_train_bytes_fp16_scaled",
+    "train_sps_fused33",
+    "train_fused33_spread_pct",
     "window_data_wait_p50_ms",
     "window_data_wait_p99_ms",
     "window_queue_depth_p50",

@@ -70,6 +70,18 @@ VARIANTS = {
         FeatureNetArch(), kernels=(7, 3, 3, 3),
         pool_after=(True, False, False, True), head_gap=True,
     ),
+    # Round-12 roofline lever (ops/conv33.py): the 3^3 stride-1 blocks
+    # lowered as 27 tap-unrolled channels-last matmuls instead of XLA's
+    # generic conv — the memory-bound-program attack the PR-9 roofline
+    # justifies. fused33 on the paper shape specializes its two 3^3
+    # blocks; k3_fused33 is the apples-to-apples against "k3" (all
+    # non-stem blocks 3^3, so the specialization covers the FLOPs bulk).
+    "fused33": dataclasses.replace(
+        FeatureNetArch(), conv_backend="fused33"
+    ),
+    "k3_fused33": dataclasses.replace(
+        FeatureNetArch(), kernels=(7, 3, 3, 3), conv_backend="fused33"
+    ),
 }
 
 

@@ -1,0 +1,114 @@
+"""Layout-specialized 3³ stride-1 convolution: tap-unrolled channel
+matmuls on the channels-last grid.
+
+Why this exists (the roofline's verdict, not a hunch): PR 9's per-program
+cost attribution classifies the serving forwards memory-bound on v5e —
+arithmetic intensity under the ridge point, achieved bandwidth the
+binding resource — and the 3³ stride-1 blocks are where the bytes go
+once the strided stem is out of the way (the ``ops/stem.py`` s2d
+reformulation that bought 8.3k→16.7k sps is the precedent for attacking
+exactly the block the profile names). XLA's generic conv lowering
+materializes its own im2col-ish intermediates for these shapes; this
+module lowers the same conv as **27 tap-shifted channel contractions**
+instead:
+
+    out = Σ_{kz,ky,kx}  shift(x, kz-1, ky-1, kx-1) @ w[kz, ky, kx]
+
+Each term is a ``[B·D·H·W, Cin] × [Cin, Cout]`` matmul — the MXU's
+native shape, consumed directly from the NDHWC (channels-last) layout
+with **zero data movement beyond one SAME-pad**: every "shift" is a
+static slice view of the padded grid, no patch tensor is ever built, and
+XLA fuses the 27 multiply-adds into one accumulation loop over a single
+fp32 scratch. Accumulation is explicitly fp32 (``preferred_element_type``)
+regardless of the activation dtype, so bf16/fp16 serving precisions keep
+fp32-quality sums exactly like the XLA path.
+
+Autodiff is native: the expression is pure ``jnp``/``lax.dot_general``,
+so dx lowers to the transposed tap sum and dw to 27 position
+contractions — no custom VJP to maintain (contrast ``ops/conv3d.py``).
+
+Selected per-arch via ``FeatureNetArch.conv_backend="fused33"`` (CLI
+``--conv-backend fused33``): ConvBNRelu routes its stride-1 kernel-3
+blocks here and every other shape falls back to ``nn.Conv`` unchanged.
+The backend rides the runtime fingerprint through the arch identity
+(``runtime.registry``), so an executable cache can never hand a fused33
+run the generic lowering. ``ops/bench_arch.py`` carries the comparison
+rows (``fused33`` / ``k3_fused33``) and bench.py measures the flagship
+under it (``train_sps_fused33``) — TPU round r06 pins whether the
+specialization pays; the numerics are pinned on CPU either way
+(tests/test_ops.py, forward AND gradients against ``lax.conv``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def fused33_conv(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """3³ stride-1 SAME conv as 27 tap-unrolled channel matmuls.
+
+    ``x``: ``[B, D, H, W, Cin]`` (NDHWC); ``w``: ``[3, 3, 3, Cin, Cout]``
+    (the reference parametrization — same leaf shape as ``nn.Conv``).
+    Matches ``lax.conv_general_dilated(..., (1,1,1), "SAME")`` to
+    accumulation-order rounding; accumulates fp32, returns at ``x``'s
+    dtype.
+    """
+    if w.shape[:3] != (3, 3, 3):
+        raise ValueError(f"fused33_conv is specialized to 3^3 kernels; "
+                         f"got {w.shape}")
+    b, d, h, w_, cin = x.shape
+    cout = w.shape[-1]
+    w = w.astype(x.dtype)
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (1, 1), (0, 0)))
+    acc = None
+    for kz in range(3):
+        for ky in range(3):
+            for kx in range(3):
+                # Static slice view of the padded grid — the "shift" is
+                # free; the contraction below is the only data touch.
+                xs = xp[:, kz:kz + d, ky:ky + h, kx:kx + w_, :]
+                term = jax.lax.dot_general(
+                    xs, w[kz, ky, kx],
+                    (((4,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                acc = term if acc is None else acc + term
+    return acc.astype(x.dtype)
+
+
+class Fused33Conv(nn.Module):
+    """Stride-1 SAME 3³ conv block backed by ``fused33_conv`` (no bias).
+
+    Parameter ``kernel`` has the same ``[3,3,3,Cin,Cout]`` shape and init
+    as ``nn.Conv``'s, and ConvBNRelu instantiates it under nn.Conv's
+    param scope name (``name="Conv_0"``) so the param TREE matches the
+    xla backend's exactly — a checkpoint trained under either backend
+    restores under the other (``config._identity_view`` neutralizes
+    ``conv_backend`` for exactly this A/B-one-trained-run use; contrast
+    HybridConv/PallasConv, whose auto-named scopes make their trees
+    backend-specific). Activations stay in ``dtype``; accumulation is
+    fp32 inside the tap loop.
+    """
+
+    features: int
+    kernel_size: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if self.kernel_size != 3:
+            raise ValueError(
+                f"Fused33Conv is the 3^3 specialization; got kernel "
+                f"{self.kernel_size} (ConvBNRelu routes other shapes to "
+                "nn.Conv)"
+            )
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(batch_axis=(), in_axis=(0, 1, 2, 3)),
+            (3, 3, 3, cin, self.features),
+            jnp.float32,
+        )
+        return fused33_conv(x.astype(self.dtype), kernel)

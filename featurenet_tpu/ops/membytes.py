@@ -59,18 +59,21 @@ def state_bytes(params_n: int, optimizer: str = "adamw",
     slots + the gradient tree live during the update.
 
     ``precision`` is the training precision policy
-    (``train/precision.py``). Under ``bf16_master`` the step additionally
-    holds a bf16 WORKING copy of the params (2 bytes/param) and stores
-    the backward's gradients in bf16 (2) — but the fp32 upcast of those
-    gradients (4) is live through the optimizer update, so first-order
-    both gradient trees are counted alongside the fp32 masters. Net: the
-    master split trades activation-side casts for ~1.25x the state-side
-    bytes (20 vs 16 bytes/param with adamw; 16 vs 12 with sgd) —
-    negligible against activations for these ~4M-param configs, but the
-    model must say it, not hide it."""
+    (``train/precision.py``). Under the master/working split policies
+    (``bf16_master`` and ``fp16_scaled`` — bfloat16 and float16 are both
+    2 bytes, so the byte model is identical) the step additionally holds
+    a 2-byte WORKING copy of the params and stores the backward's
+    gradients at 2 bytes — but the fp32 upcast of those gradients (4) is
+    live through the optimizer update, so first-order both gradient
+    trees are counted alongside the fp32 masters. (fp16_scaled's
+    loss-scale state is two scalars — not a term.) Net: the master
+    split trades activation-side casts for ~1.25x the state-side bytes
+    (20 vs 16 bytes/param with adamw; 16 vs 12 with sgd) — negligible
+    against activations for these ~4M-param configs, but the model must
+    say it, not hide it."""
     slots = {"adamw": 2, "adam": 2, "sgd": 1}.get(optimizer, 2)
-    if precision == "bf16_master":
-        # masters(4) + working(2) + bf16 grads(2) + fp32 grads(4) + slots
+    if precision in ("bf16_master", "fp16_scaled"):
+        # masters(4) + working(2) + 2-byte grads(2) + fp32 grads(4) + slots
         return int(params_n * (12 + 4 * slots))
     return int(params_n * 4 * (2 + slots))  # params + grads + slots
 

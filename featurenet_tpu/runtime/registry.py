@@ -39,18 +39,25 @@ Program catalog (availability depends on the config):
                     directly on their devices)
 ``train_step``      one fused fwd+bwd+optimizer+BN step (donated state);
                     precision-variant per ``Config.train_precision``
-                    (fp32 | bf16_master — the policy is in the cache
-                    fingerprint, so a cross-precision hit is impossible)
+                    (fp32 | bf16_master | fp16_scaled — the policy is in
+                    the cache fingerprint, so a cross-precision hit is
+                    impossible; fp16_scaled adds dynamic loss scaling)
 ``multi_train_step``  ``k`` steps fused into one executable
                     (``steps_per_dispatch > 1``); precision-variant
 ``hbm_train_step``  steps that sample batches from the HBM-resident split
                     (``hbm_cache``; needs the resident arrays' shapes)
-``eval_step``       exact-sum eval forward
+``eval_step``       exact-sum eval forward; serve-precision-variant per
+                    ``Config.serve_precision`` (the cast is baked into
+                    the traced step and fingerprinted like the train
+                    policies)
 ``serve``           the Predictor forward: fp32 weights → probs (classify)
                     or int8 per-voxel labels (segment); single-device
+``serve_bf16``      same forward with the bf16 working-copy cast compiled
+                    inside (masters stay fp32; 2-byte weight reads)
 ``serve_int8``      same forward over int8-quantized weights
 ``serve_packed``    the bench serving program: packed voxels → labels,
                     sharded over the mesh (classify only)
+``serve_packed_bf16``  its bf16 working-copy variant
 ``serve_packed_int8``  its int8-weight variant
 ==================  =========================================================
 """
@@ -73,7 +80,12 @@ from featurenet_tpu.runtime.cache import (
     program_fingerprint,
 )
 
-PRECISIONS = ("fp32", "int8")
+# Serving weight precisions (Config.serve_precision / Predictor
+# precision). Mirrors train.precision.SERVE_PRECISIONS — importing it at
+# module scope would cycle through train/__init__ → train.loop → this
+# module, so the literal is duplicated here and pinned equal by
+# tests/test_runtime.py.
+PRECISIONS = ("fp32", "bf16", "int8")
 
 _FROM_CONFIG = object()  # sentinel: derive the cache from cfg.exec_cache_dir
 
@@ -317,23 +329,64 @@ def _spec_hbm_train_step(rt: "Runtime", num_steps: int = 1,
 def _spec_eval_step(rt: "Runtime") -> ProgramSpec:
     from featurenet_tpu.train.steps import make_eval_step
 
+    # Precision-variant per Config.serve_precision, exactly as the train
+    # steps are per train_precision: the avals are identical across
+    # variants (fp32 masters in either way) and only the cast baked into
+    # the traced step distinguishes them — the policy lands in the spec
+    # precision AND the meta, so the exec-cache fingerprint (and entry
+    # filename) separate them and a cross-precision cache hit is
+    # impossible by construction.
+    prec = rt.cfg.serve_precision
     args = (rt.abstract_state.params, rt.abstract_state.batch_stats,
             rt.batch_avals())
     return ProgramSpec(
         name="eval_step",
-        fn=make_eval_step(rt.model, rt.cfg.task, packed=True),
+        fn=make_eval_step(rt.model, rt.cfg.task, packed=True,
+                          serve_precision=prec),
         abstract_args=args,
+        precision=prec,
         in_shardings=(rt.state_sh.params, rt.state_sh.batch_stats,
                       rt.batch_sh),
         out_shardings=rt.rep,
-        meta={"kind": "eval_step", "avals": _meta_avals(args)},
+        meta={"kind": "eval_step", "precision": prec,
+              "avals": _meta_avals(args)},
     )
+
+
+def serve_program_name(precision: str, packed: bool = False) -> str:
+    """The catalog name of the serving program at one precision — THE
+    mapping (Predictor.program_for, measure_inference, measure_ttfs, and
+    the spec builders all resolve through here, so a new rung lands in
+    one place)."""
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"unknown serving precision {precision!r}; one of "
+            f"{', '.join(PRECISIONS)}"
+        )
+    base = "serve_packed" if packed else "serve"
+    return base if precision == "fp32" else f"{base}_{precision}"
+
+
+def _bf16_params_aval(params_aval):
+    """Abstract bf16 working copy of a params tree — the serving
+    programs' param avals under the bf16 rung: the 2-byte tree is a
+    program ARGUMENT (cast once at Predictor construction, resident in
+    serving HBM), not an in-program cast of the fp32 masters, so every
+    dispatch reads half the weight bytes — the int8 path's
+    quantize-at-construction pattern applied to bf16. (eval_step is the
+    deliberate exception: it compiles the cast inside, because its job
+    is accuracy-faithful eval of the rung, not serving bandwidth.)"""
+    from featurenet_tpu.train.precision import serve_params_cast
+
+    return jax.eval_shape(lambda p: serve_params_cast(p, "bf16"),
+                          params_aval)
 
 
 def _serve_fn(rt: "Runtime"):
     """The Predictor forward: probs for classify, on-device argmax to int8
     labels for segment (so labels, not a 25-channel fp32 volume, cross
-    back to the host)."""
+    back to the host). Params arrive at the program's own precision (the
+    fp32 masters, or the pre-cast bf16 working copy)."""
     import jax.numpy as jnp
 
     model, task = rt.model, rt.cfg.task
@@ -350,16 +403,30 @@ def _serve_fn(rt: "Runtime"):
     return forward
 
 
-def _spec_serve(rt: "Runtime", batch: int = 32) -> ProgramSpec:
+def _spec_serve(rt: "Runtime", batch: int = 32,
+                precision: str = "fp32") -> ProgramSpec:
+    """The batch-shaped Predictor forward; ``precision="bf16"`` is the
+    same spec over bf16 param avals (the catalog's ``serve_bf16`` — one
+    builder, two entries; int8 stays its own spec because its
+    quantized-argument signature differs structurally)."""
     R = rt.cfg.resolution
-    args = (rt.abstract_state.params, rt.abstract_state.batch_stats,
+    name = serve_program_name(precision)
+    params_aval = rt.abstract_state.params
+    if precision == "bf16":
+        params_aval = _bf16_params_aval(params_aval)
+    args = (params_aval, rt.abstract_state.batch_stats,
             _sds((batch, R, R, R, 1), np.float32))
     return ProgramSpec(
-        name="serve",
+        name=name,
         fn=_serve_fn(rt),
         abstract_args=args,
-        meta={"kind": "serve", "batch": batch, "avals": _meta_avals(args)},
+        precision=precision,
+        meta={"kind": name, "batch": batch, "avals": _meta_avals(args)},
     )
+
+
+def _spec_serve_bf16(rt: "Runtime", batch: int = 32) -> ProgramSpec:
+    return _spec_serve(rt, batch=batch, precision="bf16")
 
 
 def _spec_serve_int8(rt: "Runtime", batch: int = 32) -> ProgramSpec:
@@ -390,8 +457,11 @@ def _packed_sharding(rt: "Runtime"):
     return batch_shardings(rt.mesh, keys=("voxels",))["voxels"]
 
 
-def _spec_serve_packed(rt: "Runtime",
-                       global_batch: Optional[int] = None) -> ProgramSpec:
+def _spec_serve_packed(rt: "Runtime", global_batch: Optional[int] = None,
+                       precision: str = "fp32") -> ProgramSpec:
+    """The packed-wire serving forward; ``precision="bf16"`` is the same
+    spec over bf16 param avals (catalog ``serve_packed_bf16``) — the
+    caller feeds the pre-cast working copy (see ``_bf16_params_aval``)."""
     import jax.numpy as jnp
 
     from featurenet_tpu.train.steps import unpack_voxels
@@ -399,20 +469,32 @@ def _spec_serve_packed(rt: "Runtime",
     model = rt.model
     B = global_batch or rt.cfg.global_batch
     R = rt.cfg.resolution
+    name = serve_program_name(precision, packed=True)
 
     def serve(variables, packed):
-        x = unpack_voxels(packed)  # [B,R,R,R,1] f32; model casts to bf16
+        x = unpack_voxels(packed)  # [B,R,R,R,1] f32; model casts onward
         logits = model.apply(variables, x, train=False)
         return jnp.argmax(logits, axis=-1)
 
-    args = (rt.abstract_variables(), _sds((B, R, R, R // 8), np.uint8))
+    var_aval = dict(rt.abstract_variables())
+    if precision == "bf16":
+        var_aval["params"] = _bf16_params_aval(var_aval["params"])
+    args = (var_aval, _sds((B, R, R, R // 8), np.uint8))
     return ProgramSpec(
-        name="serve_packed",
+        name=name,
         fn=serve,
         abstract_args=args,
+        precision=precision,
         in_shardings=(rt.rep, _packed_sharding(rt)),
-        meta={"kind": "serve_packed", "avals": _meta_avals(args)},
+        meta={"kind": name, "avals": _meta_avals(args)},
     )
+
+
+def _spec_serve_packed_bf16(rt: "Runtime",
+                            global_batch: Optional[int] = None
+                            ) -> ProgramSpec:
+    return _spec_serve_packed(rt, global_batch=global_batch,
+                              precision="bf16")
 
 
 def _spec_serve_packed_int8(rt: "Runtime",
@@ -461,12 +543,21 @@ PROGRAMS: dict[str, tuple[Callable, str, Callable[[Config], bool]]] = {
         _spec_hbm_train_step,
         "train steps sampling batches from the HBM-resident split",
         lambda cfg: cfg.hbm_cache),
-    "eval_step": (_spec_eval_step, "exact-sum eval forward", _always),
+    "eval_step": (
+        _spec_eval_step,
+        "exact-sum eval forward; serve-precision-variant", _always),
     "serve": (_spec_serve, "serving forward, fp32 weights", _always),
+    "serve_bf16": (
+        _spec_serve_bf16,
+        "serving forward, bf16 working-copy weights", _always),
     "serve_int8": (_spec_serve_int8,
                    "serving forward, int8 per-channel weights", _always),
     "serve_packed": (
         _spec_serve_packed, "packed-wire serving forward (bench/mesh)",
+        lambda cfg: cfg.task == "classify"),
+    "serve_packed_bf16": (
+        _spec_serve_packed_bf16,
+        "packed-wire serving forward, bf16 working-copy weights",
         lambda cfg: cfg.task == "classify"),
     "serve_packed_int8": (
         _spec_serve_packed_int8,
@@ -481,11 +572,16 @@ _NEEDS_RUNTIME_ARGS = frozenset({"hbm_train_step"})
 
 # Programs whose compiled executable embeds the TRAINING precision
 # policy (Config.train_precision): the train steps cast/apply under it,
-# and init bakes it into the returned state's static metadata. Serving
-# and eval run the fp32 masters (or int8-quantized weights) regardless.
+# and init bakes it into the returned state's static metadata. The
+# serving catalog is precision-variant by NAME (serve / serve_bf16 /
+# serve_int8 and their packed forms), while eval_step embeds the
+# SERVING precision policy (Config.serve_precision) the same way the
+# train steps embed theirs.
 TRAIN_PRECISION_PROGRAMS = frozenset(
     {"init", "train_step", "multi_train_step", "hbm_train_step"}
 )
+
+SERVE_PRECISION_PROGRAMS = frozenset({"eval_step"})
 
 
 def program_precision(cfg: Config, name: str) -> str:
@@ -494,8 +590,12 @@ def program_precision(cfg: Config, name: str) -> str:
     variants (the build half lives in each spec's meta/fingerprint)."""
     if name.endswith("int8"):
         return "int8"
+    if name.endswith("bf16"):
+        return "bf16"
     if name in TRAIN_PRECISION_PROGRAMS:
         return cfg.train_precision
+    if name in SERVE_PRECISION_PROGRAMS:
+        return cfg.serve_precision
     return "fp32"
 
 

@@ -144,6 +144,7 @@ class InferenceService:
 
         tris = parse_stl(data)
         grid = voxelize(tris, self.cfg.resolution, fill=fill)
+        # lint: allow-precision(wire contract: the serve input edge is fp32)
         return self.submit_voxels(grid.astype(np.float32))
 
     def format_row(self, row: np.ndarray) -> dict:

@@ -322,6 +322,13 @@ class CheckpointManager:
             "params": state.params,
             "batch_stats": state.batch_stats,
             "opt_state": state.opt_state,
+            # Dynamic loss-scaling state (fp16_scaled — inert scalars
+            # under the other policies): persisted so a resumed fp16 run
+            # keeps its ADAPTED scale instead of re-learning it from
+            # overflow, and round-trips untouched through a
+            # cross-precision restore.
+            "loss_scale": state.loss_scale,
+            "good_steps": state.good_steps,
         }
         # Snapshot BEFORE the async write: train_step DONATES the state,
         # and on the CPU backend Orbax's background writer serializes
@@ -421,8 +428,19 @@ class CheckpointManager:
             "params": state.params,
             "batch_stats": state.batch_stats,
             "opt_state": state.opt_state,
+            "loss_scale": state.loss_scale,
+            "good_steps": state.good_steps,
         }
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
+        # Checkpoints written before the loss-scale state existed carry
+        # only the first four keys; restore them against the narrower
+        # template (the live state's inert scale leaves stand in). The
+        # legacy shape is detected, not probed-by-failure, so a corrupt
+        # new-shape dir still walks back instead of half-restoring.
+        legacy_abstract = {
+            k: abstract[k]
+            for k in ("step", "params", "batch_stats", "opt_state")
+        }
         first_error: Optional[BaseException] = None
         for s in candidates:
             self._restores += 1
@@ -437,9 +455,17 @@ class CheckpointManager:
                 # structurally-valid garbage weights. A mismatch joins the
                 # existing truncation fallback below.
                 self._verify_checksums(s)
+                target = abstract
+                try:
+                    md = self._mgr.item_metadata(s)
+                    if (hasattr(md, "keys")
+                            and "loss_scale" not in md.keys()):
+                        target = legacy_abstract
+                except Exception:
+                    pass  # undecidable metadata: restore the full shape
                 with obs.span("checkpoint_restore", step=s):
                     restored = self._mgr.restore(
-                        s, args=ocp.args.StandardRestore(abstract)
+                        s, args=ocp.args.StandardRestore(target)
                     )
             except Exception as e:  # orbax raises various system errors
                 if step is not None:

@@ -1,27 +1,59 @@
-"""Training precision policy: fp32 master weights, optional bf16 working step.
+"""Precision policies: fp32 masters with reduced-precision working steps,
+for training AND inference.
 
-The model has always COMPUTED bf16 (flax modules with ``dtype=bfloat16``
-cast their fp32 params per layer inside the forward), but the training
-state itself ran fp32 end to end: fp32 params into the step, per-layer
-bf16 casts as temporaries, fp32 gradient storage out of the backward,
-fp32 optimizer math. The ``bf16_master`` policy moves the cast to the
-step boundary instead:
+Training side. The model has always COMPUTED bf16 (flax modules with
+``dtype=bfloat16`` cast their fp32 params per layer inside the forward),
+but the training state itself ran fp32 end to end: fp32 params into the
+step, per-layer bf16 casts as temporaries, fp32 gradient storage out of
+the backward, fp32 optimizer math. The reduced-precision policies move
+the cast to the step boundary instead:
 
 - the optimizer (and every checkpoint) holds **fp32 master params** —
   the masters are what's persisted, so checkpoints restore bitwise
   across precision modes;
-- the jitted train step casts ONE **bf16 working copy** of the params
-  and differentiates with respect to it — the forward runs the same
-  bf16 math it always did (minus the per-layer casts), and the backward
-  now stores the gradient tree in bf16 (half the gradient HBM);
+- the jitted train step casts ONE working copy of the params
+  (``bf16_master``: bfloat16; ``fp16_scaled``: float16) and
+  differentiates with respect to it — the backward then stores the
+  gradient tree at the working dtype (half the gradient HBM);
 - the gradients are upcast to fp32 at the step boundary and the update
-  applies to the masters — optimizer accumulation never runs in bf16.
+  applies to the masters — optimizer accumulation never runs reduced.
+
+``fp16_scaled`` additionally runs **dynamic loss scaling**: float16's
+narrow exponent (max ~65504, min normal ~6e-5) means small backward
+cotangents flush to zero and large ones overflow where bfloat16's
+fp32-range exponent shrugs — so the loss is multiplied by a running
+scale before the backward, the gradients are unscaled in fp32 after it,
+and the scale adapts: ``LOSS_SCALE_GROWTH_INTERVAL`` consecutive
+finite-gradient steps double it (up to ``LOSS_SCALE_MAX``); a non-finite
+gradient tree halves it (down to ``LOSS_SCALE_MIN``) and the update is
+SKIPPED bitwise — masters, optimizer slots, and BN stats unchanged, only
+the step counter and the scale state advance. The scale state
+(``TrainState.loss_scale`` / ``good_steps``) is part of the train-state
+pytree, so checkpoints persist and restore it like the masters — a
+resumed fp16 run does not re-learn its scale from overflow. bf16_master
+needs none of this (bf16 shares fp32's exponent range), which is exactly
+why fp16 is the rung that matters on backends where fp16 is the fast
+path and bf16 is not.
 
 ``fp32`` is the identity policy: the masters ARE the working copy and
 no cast exists anywhere (the compiled step is unchanged). The policy
 name rides ``TrainState`` as static metadata (``state.precision``), so
-one ``make_train_step`` serves both modes and the runtime registry
-fingerprints the two executables apart (``runtime.registry``).
+one ``make_train_step`` serves every mode and the runtime registry
+fingerprints the executables apart (``runtime.registry``).
+
+Inference side. ``serve_params_cast`` is the same working-copy idea
+extended to serving (``Config.serve_precision``): ``bf16`` casts the
+fp32 masters to bfloat16, ``int8`` round-trips through the per-channel
+quantizer (``runtime.quantize``) — the accuracy-faithful stand-in the
+precision-agnostic agreement gate compares against — and ``fp32`` is
+the identity. Where the cast runs differs by purpose: the SERVING
+programs (``serve_bf16``/``serve_packed_bf16``) take the cast tree as a
+program argument, produced ONCE at Predictor construction, so 2-byte
+weights are what serving HBM reads per dispatch (the int8
+quantize-at-construction pattern); ``eval_step`` compiles the cast
+inside instead, because its job is accuracy-faithful eval of the rung,
+not bandwidth. Masters stay fp32 in checkpoints under every serving
+precision.
 """
 
 from __future__ import annotations
@@ -30,9 +62,26 @@ import dataclasses
 from typing import Optional
 
 # The accepted Config.train_precision values — Config.validate() and the
-# CLI's --train-precision choices both mirror this pair (the config-cli
+# CLI's --train-precision choices both mirror this triple (the config-cli
 # lint rule cross-checks the surfaces).
-TRAIN_PRECISIONS = ("fp32", "bf16_master")
+TRAIN_PRECISIONS = ("fp32", "bf16_master", "fp16_scaled")
+
+# The accepted Config.serve_precision values (and Predictor precisions) —
+# mirrored by Config.validate() and the --serve-precision / --precision
+# choices the same way.
+SERVE_PRECISIONS = ("fp32", "bf16", "int8")
+
+# Dynamic loss scaling (the fp16_scaled policy). INIT = 2^15: the
+# standard warm start — large enough that ~1e-3-magnitude gradients land
+# mid-range in float16, small enough that the first steps of a fresh run
+# do not overflow (and if they do, the halving converges within a few
+# skipped steps). MAX caps growth below float16 overflow for any gradient
+# the clip/schedule regime produces; MIN floors the halving so a
+# pathological run degrades to unscaled fp16 instead of a zero scale.
+LOSS_SCALE_INIT = 2.0 ** 15
+LOSS_SCALE_GROWTH_INTERVAL = 200
+LOSS_SCALE_MAX = 2.0 ** 24
+LOSS_SCALE_MIN = 1.0
 
 
 def _cast_floating(tree, dtype):
@@ -52,18 +101,23 @@ def _cast_floating(tree, dtype):
 @dataclasses.dataclass(frozen=True)
 class PrecisionPolicy:
     """One training precision mode: how master params become the working
-    copy the forward/backward sees, and how the resulting gradients come
-    back to master dtype for the optimizer."""
+    copy the forward/backward sees, how the resulting gradients come
+    back to master dtype for the optimizer, and whether the step runs
+    dynamic loss scaling around the backward."""
 
     name: str
     # Working-copy dtype name, or None = the masters are the working copy
     # (no cast compiled anywhere — the fp32 identity policy).
     working_dtype: Optional[str] = None
+    # Dynamic loss scaling (fp16 only): scale the loss before the
+    # backward, unscale the fp32 gradients after, skip-and-halve on
+    # non-finite gradients (see the module docstring).
+    loss_scaling: bool = False
 
     def working_params(self, params):
-        """The param tree the forward/backward differentiates: a bf16
-        cast of the fp32 masters under ``bf16_master``, the masters
-        verbatim under ``fp32``."""
+        """The param tree the forward/backward differentiates: a reduced-
+        precision cast of the fp32 masters under bf16_master/fp16_scaled,
+        the masters verbatim under ``fp32``."""
         if self.working_dtype is None:
             return params
         import jax.numpy as jnp
@@ -71,9 +125,9 @@ class PrecisionPolicy:
         return _cast_floating(params, jnp.dtype(self.working_dtype))
 
     def master_grads(self, grads):
-        """Gradients at master dtype: the bf16 gradient tree upcast to
+        """Gradients at master dtype: the reduced gradient tree upcast to
         fp32 at the step boundary (optimizer accumulation must never run
-        in bf16), or the grads verbatim under ``fp32``."""
+        reduced), or the grads verbatim under ``fp32``."""
         if self.working_dtype is None:
             return grads
         import jax.numpy as jnp
@@ -84,6 +138,8 @@ class PrecisionPolicy:
 POLICIES = {
     "fp32": PrecisionPolicy("fp32", None),
     "bf16_master": PrecisionPolicy("bf16_master", "bfloat16"),
+    "fp16_scaled": PrecisionPolicy("fp16_scaled", "float16",
+                                   loss_scaling=True),
 }
 
 
@@ -97,3 +153,50 @@ def get_policy(name: str) -> PrecisionPolicy:
             f"{', '.join(TRAIN_PRECISIONS)}"
         )
     return POLICIES[name]
+
+
+def initial_loss_scale(precision: str) -> float:
+    """The loss-scale value a fresh ``TrainState`` starts from: the
+    dynamic-scaling warm start under a loss-scaling policy, the inert 1.0
+    everywhere else (the leaves exist under EVERY policy so the state
+    treedef — and therefore cross-precision checkpoint restore — is
+    precision-independent)."""
+    return LOSS_SCALE_INIT if get_policy(precision).loss_scaling else 1.0
+
+
+def serve_params_cast(params, precision: str):
+    """The inference-side working-copy transform (``Config.
+    serve_precision``):
+
+    - ``fp32``: identity — the masters are what the forward reads.
+    - ``bf16``: one boundary cast of every floating leaf to bfloat16
+      (BN statistics live in ``batch_stats``, not here, and stay fp32);
+      the model's per-layer bf16 casts then become no-ops. The
+      Predictor/bench run this ONCE at construction and feed the 2-byte
+      tree to the serve programs as an argument; ``eval_step`` traces it
+      inside its compiled step (see the module docstring for why each).
+    - ``int8``: quantize → dequantize through the per-channel symmetric
+      quantizer (``runtime.quantize``) — numerically the int8 serving
+      program's weights, which is what makes the precision-agnostic
+      agreement gate honest for both rungs.
+
+    Masters are never mutated; the cast output is a fresh tree at the
+    reduced width.
+    """
+    if precision == "fp32":
+        return params
+    if precision == "bf16":
+        import jax.numpy as jnp
+
+        return _cast_floating(params, jnp.bfloat16)
+    if precision == "int8":
+        from featurenet_tpu.runtime.quantize import (
+            dequantize_tree,
+            quantize_tree,
+        )
+
+        return dequantize_tree(*quantize_tree(params))
+    raise ValueError(
+        f"unknown serve precision {precision!r}; one of "
+        f"{', '.join(SERVE_PRECISIONS)}"
+    )

@@ -16,18 +16,29 @@ from flax import core, struct
 
 
 class TrainState(struct.PyTreeNode):
-    """``params`` are always the fp32 MASTERS — under the ``bf16_master``
-    training precision policy (``train/precision.py``) the jitted step
-    casts a bf16 working copy for forward/backward and applies the
+    """``params`` are always the fp32 MASTERS — under the reduced
+    training precision policies (``train/precision.py``) the jitted step
+    casts a bf16/fp16 working copy for forward/backward and applies the
     (fp32-upcast) gradients back to these masters. ``precision`` is the
     policy name, carried as static metadata so one step function serves
-    both modes and a checkpoint (which persists the masters, never the
-    working copy) restores bitwise into either."""
+    every mode and a checkpoint (which persists the masters, never the
+    working copy) restores bitwise into any other.
+
+    ``loss_scale`` / ``good_steps`` are the dynamic loss-scaling state of
+    the ``fp16_scaled`` policy (current scale; consecutive finite-grad
+    steps since the last scale change). They are ordinary pytree LEAVES
+    under every policy — inert scalars (1.0 / 0) outside fp16_scaled —
+    so the state treedef is precision-independent: checkpoints carry the
+    scale state, a resumed fp16 run keeps its adapted scale, and a
+    cross-precision restore (fp16_scaled → fp32 and back) round-trips it
+    untouched."""
 
     step: jax.Array
     params: core.FrozenDict[str, Any]
     batch_stats: core.FrozenDict[str, Any]
     opt_state: optax.OptState
+    loss_scale: jax.Array
+    good_steps: jax.Array
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
     precision: str = struct.field(pytree_node=False, default="fp32")
 
@@ -67,7 +78,7 @@ def create_state(
     policy (``train/precision.py``); the initialized params are fp32
     masters under every policy.
     """
-    from featurenet_tpu.train.precision import get_policy
+    from featurenet_tpu.train.precision import get_policy, initial_loss_scale
 
     get_policy(precision)  # refuse a typo'd policy before any device work
     variables = model.init({"params": rng}, sample_input, train=False)
@@ -78,6 +89,13 @@ def create_state(
         params=params,
         batch_stats=batch_stats,
         opt_state=tx.init(params),
+        # Present under every policy (inert outside fp16_scaled) so the
+        # state treedef — and cross-precision checkpoint restore — never
+        # depends on the precision mode.
+        loss_scale=jax.numpy.asarray(
+            initial_loss_scale(precision), jax.numpy.float32
+        ),
+        good_steps=jax.numpy.zeros((), dtype=jax.numpy.int32),
         tx=tx,
         precision=precision,
     )

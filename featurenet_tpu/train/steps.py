@@ -117,6 +117,78 @@ def segmentation_loss(
     return loss, {"loss": loss, "accuracy": acc, "fg_accuracy": fg_acc}
 
 
+def _scaled_update(loss_fn, policy, state: TrainState, voxels, target,
+                   dropout_rng):
+    """The fp16_scaled step body: dynamic loss scaling around the
+    backward (``train/precision.py`` constants).
+
+    The loss is multiplied by ``state.loss_scale`` before the backward
+    (so float16 cotangents neither underflow nor overflow at healthy
+    scales), the gradients are upcast to fp32 and UNSCALED, and the
+    finiteness of the whole unscaled gradient tree decides the step:
+
+    - finite: the update applies to the masters exactly like the other
+      policies; ``LOSS_SCALE_GROWTH_INTERVAL`` consecutive finite steps
+      double the scale (capped at ``LOSS_SCALE_MAX``).
+    - non-finite (overflowed backward): the update is skipped BITWISE —
+      masters, optimizer slots, and BN stats keep their exact bits, only
+      the step counter advances — and the scale halves (floored at
+      ``LOSS_SCALE_MIN``), so the next step retries at a survivable
+      scale. The skip/scale verdict is branchless (``jnp.where`` over
+      the state leaves): one executable serves both outcomes.
+
+    Metrics gain ``loss_scale`` (post-verdict) and ``grads_finite`` so a
+    skipped step is visible in the log stream, not inferred.
+    """
+    from featurenet_tpu.train.precision import (
+        LOSS_SCALE_GROWTH_INTERVAL,
+        LOSS_SCALE_MAX,
+        LOSS_SCALE_MIN,
+    )
+
+    scale = state.loss_scale
+
+    def scaled_loss(params, batch_stats, vox, tgt, rng):
+        loss, aux = loss_fn(params, batch_stats, vox, tgt, rng)
+        return loss * scale.astype(loss.dtype), aux
+
+    grads, (new_stats, metrics) = jax.grad(scaled_loss, has_aux=True)(
+        policy.working_params(state.params), state.batch_stats,
+        voxels, target, dropout_rng
+    )
+    # Upcast FIRST, then unscale: dividing in float16 would re-overflow
+    # the very gradients the scale protected.
+    grads = policy.master_grads(grads)
+    inv = 1.0 / scale
+    grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+    finite = jnp.array(True)
+    for g in jax.tree_util.tree_leaves(grads):
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    applied = state.apply_gradients(grads=grads, batch_stats=new_stats)
+    # The skip twin: identical bits everywhere, step advanced (the run's
+    # schedule/rng stream must not stall on a skipped update).
+    skipped = state.replace(step=state.step + 1)
+    merged = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(finite, a, b), applied, skipped
+    )
+    good = jnp.where(finite, state.good_steps + 1,
+                     jnp.zeros_like(state.good_steps))
+    grow = good >= LOSS_SCALE_GROWTH_INTERVAL
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grow, jnp.minimum(scale * 2.0, LOSS_SCALE_MAX), scale),
+        jnp.maximum(scale * 0.5, LOSS_SCALE_MIN),
+    )
+    good = jnp.where(grow, jnp.zeros_like(good), good)
+    state = merged.replace(loss_scale=new_scale, good_steps=good)
+    metrics = dict(metrics)
+    metrics["grad_norm"] = optax.global_norm(grads)
+    metrics["loss_scale"] = new_scale
+    # lint: allow-precision(host-facing metric scalar, not step dataflow)
+    metrics["grads_finite"] = finite.astype(jnp.float32)
+    return state, metrics
+
+
 def make_train_step(
     model,
     task: str = "classify",
@@ -215,14 +287,21 @@ def make_train_step(
             )
             voxels = jnp.abs(voxels - flip.astype(voxels.dtype))
         # Precision policy (train/precision.py): differentiate with
-        # respect to the WORKING copy — under bf16_master that is a bf16
-        # cast of the fp32 masters compiled inside this step (the
-        # donated-buffer dataflow; the cast's output is a fresh buffer,
-        # never the donated masters), so the backward stores bf16
-        # gradients. They come back to fp32 at the step boundary and the
-        # update applies to the masters. Under fp32 both calls are the
-        # identity and this step compiles exactly as it always did.
+        # respect to the WORKING copy — under bf16_master/fp16_scaled
+        # that is a reduced-precision cast of the fp32 masters compiled
+        # inside this step (the donated-buffer dataflow; the cast's
+        # output is a fresh buffer, never the donated masters), so the
+        # backward stores reduced gradients. They come back to fp32 at
+        # the step boundary and the update applies to the masters. Under
+        # fp32 both calls are the identity and this step compiles
+        # exactly as it always did. state.precision is STATIC pytree
+        # metadata, so the loss-scaling branch below is a trace-time
+        # choice — fp32/bf16_master executables contain none of it.
         policy = state.policy
+        if policy.loss_scaling:
+            return _scaled_update(
+                loss_fn, policy, state, voxels, target, dropout_rng
+            )
         grads, (new_stats, metrics) = jax.grad(loss_fn, has_aux=True)(
             policy.working_params(state.params), state.batch_stats,
             voxels, target, dropout_rng
@@ -391,15 +470,27 @@ def make_hbm_multi_train_step(
 
 
 def make_eval_step(
-    model, task: str = "classify", packed: bool = False
+    model, task: str = "classify", packed: bool = False,
+    serve_precision: str = "fp32",
 ) -> Callable:
     """Eval step returning *sums* (not means) so batches aggregate exactly.
 
     For segmentation it also returns per-class intersection/union counts so
     the host can compute mean IoU over the whole eval set (SURVEY.md §7.5).
+
+    ``serve_precision`` (``Config.serve_precision``) applies the
+    inference-side working-copy transform to the params INSIDE the
+    compiled step — ``bf16`` casts the fp32 masters at the boundary,
+    ``int8`` round-trips the per-channel quantizer — so held-out eval
+    measures the forward serving will actually run, not an fp32 ideal of
+    it. ``fp32`` compiles the identical step it always did.
     """
 
     def eval_step(params, batch_stats, batch):
+        if serve_precision != "fp32":
+            from featurenet_tpu.train.precision import serve_params_cast
+
+            params = serve_params_cast(params, serve_precision)
         voxels = _batch_voxels(batch, packed)
         logits = model.apply(
             {"params": params, "batch_stats": batch_stats},

@@ -320,21 +320,89 @@ def test_hygiene_fp32_cast_in_hot_step(tmp_path):
     assert all("train/steps.py" in f.path for f in findings)
 
 
-def test_config_cli_rule_covers_train_precision_pair():
-    """Satellite (ISSUE 10): the config-cli rule's parsed surfaces both
-    see the new train_precision flag/choices pair on the REAL package —
-    the CLI choices list and Config.validate()'s accepted set agree, so
-    a drift on either side becomes a choices_drift finding."""
+def test_hygiene_fp32_cast_covers_serving_hot_paths(tmp_path):
+    """Satellite (ISSUE 12): the precision-cast contract extends to the
+    serving hot paths (infer.py, serve/batcher.py, serve/service.py) and
+    to numpy-side casts — an unannotated np.float32 cast on the request
+    edge is a finding, an allow-precision annotation clears it, and
+    modules outside the contract stay out of scope."""
+    _write(tmp_path, "serve/service.py", """\
+        import numpy as np
+
+        def submit(grid):
+            a = grid.astype(np.float32)
+            # lint: allow-precision(wire contract: serve input edge is fp32)
+            b = grid.astype(np.float32)
+            return a, b
+    """)
+    _write(tmp_path, "infer.py", """\
+        import numpy as np
+
+        def forward(x):
+            return np.float32(x)
+    """)
+    _write(tmp_path, "ood.py", """\
+        import numpy as np
+
+        def fine(x):
+            return x.astype(np.float32)  # not a hot-path module
+    """)
+    findings = run_lint(str(tmp_path), rules=["hygiene"])
+    got = sorted((f.check, f.path.split("/")[-1], f.line) for f in findings)
+    assert got == [
+        ("fp32_cast_in_hot_step", "infer.py", 4),
+        ("fp32_cast_in_hot_step", "service.py", 4),
+    ]
+
+
+def test_config_cli_rule_covers_precision_and_backend_pairs():
+    """Satellites (ISSUE 10/12): the config-cli rule's parsed surfaces
+    both see the precision flag/choices pairs on the REAL package — the
+    CLI choices lists and Config.validate()'s accepted sets agree for
+    train_precision, serve_precision, AND the aliased conv_backend
+    (validated through the nested ``self.arch.conv_backend`` guard), so
+    a drift on any side becomes a choices_drift finding."""
     from featurenet_tpu.analysis.lint import load_tree, package_root
     from featurenet_tpu.analysis.rules import _cli_flags, _validate_sets
 
     tree = load_tree(package_root())
     flags = {d: choices for _, d, _, choices
              in _cli_flags(tree.module("cli.py"))}
-    assert "train_precision" in flags
-    assert set(flags["train_precision"]) == {"fp32", "bf16_master"}
     accepted = _validate_sets(tree.module("config.py"))
-    assert accepted["train_precision"][0] == {"fp32", "bf16_master"}
+    assert set(flags["train_precision"]) == {
+        "fp32", "bf16_master", "fp16_scaled"
+    }
+    assert accepted["train_precision"][0] == set(flags["train_precision"])
+    assert set(flags["serve_precision"]) == {"fp32", "bf16", "int8"}
+    assert accepted["serve_precision"][0] == set(flags["serve_precision"])
+    # The aliased nested pair: --conv-backend narrows arch.conv_backend.
+    assert set(flags["conv_backend"]) == {
+        "xla", "pallas", "hybrid_dw", "fused33"
+    }
+    assert accepted["conv_backend"][0] == set(flags["conv_backend"])
+
+
+def test_config_cli_nested_choices_drift_fires(tmp_path):
+    """A sub-config field restricted via ``self.arch.X not in (...)``
+    whose aliased flag narrows to a DIFFERENT set is a choices_drift —
+    the nested guard is under the same contract as the flat ones."""
+    _write(tmp_path, "config.py", """\
+        class Config:
+            a: int = 1
+            def validate(self):
+                if self.arch.conv_backend not in ("xla", "fused33"):
+                    raise ValueError("bad")
+    """)
+    _write(tmp_path, "cli.py", """\
+        FLAG_ALIASES = {}
+        def _add_override_flags(p):
+            p.add_argument("--conv-backend", choices=["xla"])
+        def _overrides(args):
+            keys = []
+    """)
+    findings = run_lint(str(tmp_path), rules=["config-cli"])
+    drift = [f for f in findings if f.check == "choices_drift"]
+    assert len(drift) == 1 and "--conv-backend" in drift[0].msg
 
 
 # --- rule: config-cli --------------------------------------------------------

@@ -254,3 +254,137 @@ def test_hybrid_backend_trains_smoke():
     assert all(
         np.isfinite(np.asarray(v)).all() for v in jax.tree_util.tree_leaves(g)
     )
+
+
+# --- fused33: layout-specialized 3^3 tap-unrolled conv (ISSUE 12) ------------
+
+def test_fused33_conv_fwd_and_grads_match_xla_conv():
+    """fused33_conv (ops/conv33.py): forward, dx, and dw all match
+    lax.conv to accumulation-order rounding — the specialization changes
+    the lowering, never the math."""
+    from featurenet_tpu.ops.conv33 import fused33_conv
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 8, 4)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 3, 3, 4, 6)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(fused33_conv(x, w)), np.asarray(ref_conv(x, w)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+    def loss(fn):
+        return lambda x, w: (fn(x, w) ** 2).sum()
+
+    gx, gw = jax.grad(loss(fused33_conv), argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss(ref_conv), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=2e-4, atol=2e-4)
+    # Non-3^3 kernels are refused, not silently mis-lowered (ConvBNRelu
+    # routes those to nn.Conv).
+    w5 = jnp.asarray(rng.standard_normal((5, 5, 5, 4, 6)), jnp.float32)
+    with pytest.raises(ValueError, match="3"):
+        fused33_conv(x, w5)
+
+
+def test_fused33_backend_trains_and_matches_xla_numerics():
+    """A FeatureNet with conv_backend='fused33' trains (finite grads),
+    its param TREE is identical to the xla backend's (Fused33Conv pins
+    nn.Conv's scope name, so a checkpoint restores under either backend
+    — the A/B the conv_backend identity exemption exists for), and the
+    xla model's weights applied through the fused33 model produce the
+    same eval logits to working-precision rounding."""
+    import dataclasses
+
+    from featurenet_tpu.models import FeatureNet
+    from featurenet_tpu.models.featurenet import tiny_arch
+
+    arch33 = dataclasses.replace(tiny_arch(), conv_backend="fused33")
+    model33 = FeatureNet(arch=arch33)
+    model_x = FeatureNet(arch=tiny_arch())
+    x = jnp.asarray(
+        np.random.default_rng(0).random((2, 16, 16, 16, 1)), jnp.float32
+    )
+    v33 = model33.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+        x, train=True,
+    )
+    vx = model_x.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+        x, train=True,
+    )
+    # Identical tree: same structure, same leaf shapes — the xla
+    # checkpoint drops into the fused33 model verbatim.
+    assert (jax.tree_util.tree_structure(v33["params"])
+            == jax.tree_util.tree_structure(vx["params"]))
+    out33 = model33.apply(
+        {"params": vx["params"], "batch_stats": vx["batch_stats"]},
+        x, train=False,
+    )
+    outx = model_x.apply(
+        {"params": vx["params"], "batch_stats": vx["batch_stats"]},
+        x, train=False,
+    )
+    np.testing.assert_allclose(np.asarray(out33), np.asarray(outx),
+                               rtol=2e-2, atol=2e-2)  # bf16 compute
+
+    def loss(params):
+        out, _ = model33.apply(
+            {"params": params, "batch_stats": v33["batch_stats"]},
+            x, train=True, rngs={"dropout": jax.random.key(2)},
+            mutable=["batch_stats"],
+        )
+        return (out ** 2).mean()
+
+    g = jax.grad(loss)(v33["params"])
+    assert all(
+        np.isfinite(np.asarray(v)).all() for v in jax.tree_util.tree_leaves(g)
+    )
+
+
+def test_bench_arch_carries_fused33_comparison_rows():
+    """ops/bench_arch.py is the harness of record for the stem ladder:
+    the fused33 comparison rows exist (fused33 vs paper, k3_fused33 vs
+    k3) so TPU round r06 measures the specialization in one pass."""
+    from featurenet_tpu.ops.bench_arch import VARIANTS
+
+    assert VARIANTS["fused33"].conv_backend == "fused33"
+    assert VARIANTS["k3_fused33"].conv_backend == "fused33"
+    assert VARIANTS["k3_fused33"].kernels == (7, 3, 3, 3)
+    # The apples-to-apples pairs differ ONLY in the backend.
+    import dataclasses
+
+    assert dataclasses.replace(
+        VARIANTS["fused33"], conv_backend="xla"
+    ) == VARIANTS["paper"]
+    assert dataclasses.replace(
+        VARIANTS["k3_fused33"], conv_backend="xla"
+    ) == VARIANTS["k3"]
+
+
+@pytest.mark.slow
+def test_fused33_cpu_comparison_row_measures():
+    """The bench comparison row for the layout-specialized stem, measured
+    on CPU (the converged-slope protocol end to end over the fused33
+    train_step vs the xla one — TPU r06 pins the real ratio; this proves
+    the row's machinery and records a CPU reference in the test log)."""
+    import dataclasses
+
+    from featurenet_tpu.benchmark import measure_train_step
+    from featurenet_tpu.config import get_config
+
+    cfg = get_config("smoke16")
+    rows = {}
+    for backend in ("xla", "fused33"):
+        bcfg = dataclasses.replace(
+            cfg, arch=dataclasses.replace(cfg.arch, conv_backend=backend)
+        ).validate()
+        rows[backend] = measure_train_step(
+            bcfg, batch_per_chip=4, repeats=1, measure=2,
+            min_window_sec=0.2,
+        )
+        assert rows[backend]["samples_per_sec_per_chip"] > 0
+    ratio = (rows["fused33"]["samples_per_sec_per_chip"]
+             / rows["xla"]["samples_per_sec_per_chip"])
+    print(f"fused33 vs xla (CPU, smoke16): {ratio:.2f}x")

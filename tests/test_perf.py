@@ -219,6 +219,43 @@ def test_program_cost_precision_attributed_in_report():
     assert "precision" not in legacy["perf"]["programs"]["train_step"]
 
 
+def test_perf_table_renders_serve_precision_variants():
+    """Satellite (ISSUE 12): the serve-precision variants (serve /
+    serve_bf16 / serve_int8) land in the perf table as distinct rows
+    with their precision column, mirroring how the train variants list
+    — built from the REAL registry's own program_cost emissions, not
+    synthetic events."""
+    import jax
+
+    from featurenet_tpu import obs
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.obs.report import (
+        build_report,
+        format_report,
+        load_events,
+    )
+    from featurenet_tpu.runtime import Runtime
+
+    import tempfile
+
+    run_dir = tempfile.mkdtemp(prefix="fn_perf_prec_")
+    obs.init_run(run_dir, process_index=0)
+    rt = Runtime(get_config("smoke16"), cache=None)
+    for name in ("serve", "serve_bf16", "serve_int8"):
+        rt.build(name, batch=2)
+    obs.close_run()
+    events, _ = load_events(run_dir)
+    rep = build_report(events)
+    progs = rep["perf"]["programs"]
+    assert progs["serve_bf16"]["precision"] == "bf16"
+    assert progs["serve_int8"]["precision"] == "int8"
+    rendered = format_report(rep)
+    assert "serve_bf16" in rendered and "serve_int8" in rendered
+    import shutil
+
+    shutil.rmtree(run_dir, ignore_errors=True)
+
+
 # --- report / trace / follow plumbing over synthetic events ------------------
 
 def _synthetic_events(device_kind="TPU v5e"):

@@ -301,6 +301,109 @@ def test_no_cache_no_files(tmp_path):
     assert Runtime(cfg2).cache is not None
 
 
+# --- serving precision ladder (bf16 working-copy rung) -----------------------
+
+def test_registry_precisions_mirror_serve_precisions():
+    """registry.PRECISIONS is a literal mirror of
+    train.precision.SERVE_PRECISIONS (importing it would cycle through
+    train/__init__) — pin them equal so the mirror cannot drift."""
+    from featurenet_tpu.runtime.registry import PRECISIONS
+    from featurenet_tpu.train.precision import SERVE_PRECISIONS
+
+    assert PRECISIONS == SERVE_PRECISIONS
+
+
+def test_bf16_serving_agreement_meets_paper_target():
+    """The precision-agnostic agreement gate (ISSUE 12 acceptance): bf16
+    serving must agree with the fp32 forward on held-out-style parts at
+    the paper's >= 96.7% bar, through the same gate the int8 rung uses —
+    and the bf16 Predictor's actual predictions must match the fp32
+    Predictor's labels on the reference inputs."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.quantize import PAPER_TOP1_TARGET
+
+    cfg = get_config("smoke16")
+    rt = Runtime(cfg, cache=None)
+    state = rt.build("init")(jax.random.key(0))
+    bf = Predictor(state.params, state.batch_stats, cfg, batch=8,
+                   precision="bf16")
+    assert bf.precision == "bf16"
+    agreement = bf.agreement(n=48, seed=0)
+    assert agreement >= PAPER_TOP1_TARGET, (
+        f"bf16 agreement {agreement} < paper target"
+    )
+    # And on real predictions: same labels as the fp32 path.
+    grids = generate_batch(
+        np.random.default_rng(1), 6, cfg.resolution
+    )["voxels"]
+    fp = Predictor(state.params, state.batch_stats, cfg, batch=8)
+    lf, pf = fp.predict_voxels(grids)
+    lb, pb = bf.predict_voxels(grids)
+    assert (lf == lb).mean() >= PAPER_TOP1_TARGET
+    np.testing.assert_allclose(pf, pb, atol=0.05)  # probs move, argmax not
+
+
+def test_predictor_precision_defaults_to_config_serve_precision():
+    """Predictor(precision=None) serves Config.serve_precision — the
+    config is the fleet-wide source; an explicit argument still wins."""
+    cfg = get_config("smoke16", serve_precision="bf16")
+    rt = Runtime(cfg, cache=None)
+    state = rt.build("init")(jax.random.key(0))
+    from featurenet_tpu.infer import Predictor
+
+    p = Predictor(state.params, state.batch_stats, cfg, batch=4)
+    assert p.precision == "bf16"
+    assert p.program_for(4).name == "serve_bf16"
+    explicit = Predictor(state.params, state.batch_stats, cfg, batch=4,
+                         precision="fp32")
+    assert explicit.precision == "fp32"
+
+
+def test_cli_programs_serve_precision_variants(capsys):
+    """`cli programs` renders the serve-precision variants — serve /
+    serve_bf16 / serve_int8 and their packed forms — with the precision
+    column, and --serve-precision flips eval_step's variant the way
+    --train-precision flips the train programs'."""
+    from featurenet_tpu.cli import main
+
+    main(["programs", "--config", "smoke16", "--serve-precision", "bf16"])
+    rows = {r["program"]: r for r in (
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    )}
+    assert rows["eval_step"]["precision"] == "bf16"
+    assert rows["serve"]["precision"] == "fp32"
+    assert rows["serve_bf16"]["precision"] == "bf16"
+    assert rows["serve_packed_bf16"]["precision"] == "bf16"
+    assert rows["serve_int8"]["precision"] == "int8"
+    assert rows["serve_packed_int8"]["precision"] == "int8"
+    # The train programs are untouched by the serving policy.
+    assert rows["train_step"]["precision"] == "fp32"
+
+
+def test_eval_step_serve_precision_no_cross_precision_cache_hit(
+        tmp_path, run_events):
+    """eval_step's serving precision lands in the exec-cache fingerprint
+    AND the entry filename exactly as train_precision does: two configs
+    differing only in serve_precision sharing one cache dir coexist —
+    two misses, two compiles, two entries, zero rejects, and never a
+    cross-precision hit."""
+    cache_dir = str(tmp_path / "exec")
+    for prec in ("fp32", "bf16"):
+        cfg = get_config("smoke16", serve_precision=prec)
+        rt = Runtime(cfg, cache=ExecutableCache(cache_dir))
+        prog = rt.build("eval_step")
+        assert prog.source == "fresh"
+        assert prog.precision == prec
+    entries = [f for f in os.listdir(cache_dir) if f.endswith(".jexec")]
+    assert len(entries) == 2
+    kinds = _cache_events(run_events())
+    assert sum(k[0] == "cache_miss" for k in kinds) == 2
+    assert sum(k[0] == "program_compile" for k in kinds) == 2
+    assert not [k for k in kinds if k[0] == "cache_reject"]
+
+
 # --- int8 serving path -------------------------------------------------------
 
 def test_quantize_per_channel_shapes_and_error_bound():
@@ -395,12 +498,21 @@ def test_trainer_builds_through_registry(tmp_path):
     assert {name for name, _ in tr._programs} == {"train_step", "eval_step"}
 
 
-def test_ttfs_warm_start_hits_cache(tmp_path):
+@pytest.mark.parametrize("precision,program", [
+    ("fp32", "serve_packed"),
+    ("bf16", "serve_packed_bf16"),
+])
+def test_ttfs_warm_start_hits_cache(tmp_path, precision, program):
     """measure_ttfs: the warm build must actually come from the cache
-    (this is the headline the bench pins)."""
+    (this is the headline the bench pins) — per serving precision, since
+    a fleet replica warms ONE precision's ladder (the bf16 bucket ladder
+    is what a bf16 fleet actually deserializes)."""
     from featurenet_tpu.benchmark import measure_ttfs
 
-    t = measure_ttfs(get_config("smoke16"), batch_per_chip=4)
+    t = measure_ttfs(get_config("smoke16"), batch_per_chip=4,
+                     precision=precision)
+    assert t["program"] == program
+    assert t["precision"] == precision
     assert t["ttfs_cold_s"] > 0 and t["ttfs_warm_s"] > 0
     assert t["warm_source"] == "cache"
 

@@ -779,3 +779,109 @@ def test_bench_probe_parses_platform_through_noise(monkeypatch, capsys):
     assert rec["skipped"] is True
     assert rec["reason"] == "no_accelerator_platform"
     assert rec["error"] is None
+
+
+# --- serving precision ladder + fleet-shared exec cache (ISSUE 12) -----------
+
+def test_bf16_service_e2e_zero_compiles_agreement_gated(tmp_path, rng):
+    """bf16 serving acceptance: a service built at precision="bf16"
+    answers real requests with labels matching the fp32 batch-mode
+    reference at the paper's >= 96.7% bar, and not one program_compile
+    event lands after warmup (the AOT contract holds for the bf16
+    bucket ladder exactly as for fp32)."""
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.quantize import PAPER_TOP1_TARGET
+    from featurenet_tpu.runtime.registry import build_model
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    cfg = get_config("smoke16", data_workers=1)
+    variables = build_model(cfg).init(
+        jax.random.key(0), jnp.zeros((1, RES, RES, RES, 1), jnp.float32),
+        train=False,
+    )
+    fp = Predictor(
+        variables["params"], variables["batch_stats"], cfg, batch=4
+    )
+    bf = Predictor(
+        variables["params"], variables["batch_stats"], cfg, batch=4,
+        precision="bf16",
+    )
+    assert bf.agreement(n=24, seed=0) >= PAPER_TOP1_TARGET
+    service = InferenceService(
+        bf, buckets=(1, 4), max_wait_ms=25, queue_limit=64, rules=(),
+    )
+    events, _ = load_events(run_dir)
+    warm = sum(1 for e in events if e["ev"] == "program_compile")
+
+    grids = generate_batch(rng, 12, RES)["voxels"]
+    expected, _ = fp.predict_voxels(grids)  # fp32 reference labels
+    futs = [service.submit_voxels(g) for g in grids]
+    got = np.array([service.predict(f)["label"] for f in futs])
+    assert (got == expected).mean() >= PAPER_TOP1_TARGET
+    service.drain()
+    obs.close_run()
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    total = sum(1 for e in events if e["ev"] == "program_compile")
+    assert total == warm  # ZERO compiles post-warmup
+
+
+def test_fleet_shared_exec_cache_second_service_all_hits(tmp_path):
+    """Fleet-shared exec cache (carried follow-on): N services sharing
+    one --exec-cache-dir coexist safely — the probe-verified loads
+    already guard the files — and a SECOND service over the same dir
+    warms every bucket from cache: one cache_hit per bucket executable,
+    ZERO program_compile events, and the deserialized ladder still
+    answers requests correctly."""
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.registry import build_model
+
+    cache_dir = str(tmp_path / "exec")
+    cfg = get_config("smoke16", data_workers=1,
+                     exec_cache_dir=cache_dir)
+    variables = build_model(cfg).init(
+        jax.random.key(0), jnp.zeros((1, RES, RES, RES, 1), jnp.float32),
+        train=False,
+    )
+    buckets = (1, 2)
+
+    def build_service(run_dir):
+        obs.init_run(run_dir, process_index=0)
+        pred = Predictor(
+            variables["params"], variables["batch_stats"], cfg, batch=2,
+        )
+        return InferenceService(
+            pred, buckets=buckets, max_wait_ms=25, queue_limit=16,
+            rules=(),
+        )
+
+    # Service A: compiles and populates the shared dir.
+    svc_a = build_service(str(tmp_path / "run_a"))
+    svc_a.drain()
+    obs.close_run()
+    events_a, _ = load_events(str(tmp_path / "run_a"))
+    assert sum(1 for e in events_a
+               if e["ev"] == "program_compile") >= len(buckets)
+
+    # Service B, same dir: every bucket deserializes — cache_hit per
+    # bucket, zero compiles anywhere in its window.
+    svc_b = build_service(str(tmp_path / "run_b"))
+    fut = svc_b.submit_voxels(_grid(1.0))
+    row = svc_b.predict(fut)
+    assert "label" in row
+    svc_b.drain()
+    obs.close_run()
+    events_b, bad = load_events(str(tmp_path / "run_b"))
+    assert bad == 0
+    assert sum(1 for e in events_b if e["ev"] == "program_compile") == 0
+    hits = [e for e in events_b if e["ev"] == "cache_hit"]
+    assert len(hits) >= len(buckets)
+    assert not [e for e in events_b if e["ev"] == "cache_reject"]

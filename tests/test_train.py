@@ -305,6 +305,201 @@ def test_checkpoint_cross_precision_restore(tmp_path):
     assert t3.resume_if_available() == 1  # clean walk-back, wrong-mode disk
 
 
+def test_fp16_scaled_tracks_fp32_with_eval_parity(rng):
+    """fp16+loss-scaling acceptance (ISSUE 12): the fp16_scaled policy —
+    fp32 masters, float16 working copy + float16 gradient storage,
+    dynamic loss scaling around the backward — must track the fp32 loss
+    trajectory within the bf16_master tolerance, converge to the same
+    overfit plateau with the scale healthy (no terminal collapse), and
+    pass the cross-precision prediction gate at the paper bar."""
+    batch = generate_batch(rng, 12, resolution=16)
+    cfg = get_config("smoke16", warmup_steps=5, total_steps=120,
+                     peak_lr=3e-3)
+    model = FeatureNet(arch=tiny_arch())  # production bf16 compute dtype
+    tx = make_optimizer(cfg)
+    step = jax.jit(make_train_step(model, "classify"), donate_argnums=(0,))
+    rng_key = jax.random.key(1)
+    runs = {}
+    for prec in ("fp32", "fp16_scaled"):
+        state = create_state(
+            model, tx, jnp.asarray(batch["voxels"]), jax.random.key(0),
+            precision=prec,
+        )
+        losses = []
+        for _ in range(100):
+            state, metrics = step(state, batch, rng_key)
+            losses.append(float(metrics["loss"]))
+        for leaf in jax.tree_util.tree_leaves(state.params):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                assert leaf.dtype == jnp.float32, leaf.dtype  # masters
+        runs[prec] = (losses, state)
+    l32, l16 = runs["fp32"][0], runs["fp16_scaled"][0]
+    # The f16 boundary cast double-rounds (f32→f16→bf16), so the first
+    # loss is near- but not bit-identical; measured ~1.2e-3 at this seed.
+    assert l32[0] == pytest.approx(l16[0], abs=5e-3)
+    # Same trajectory bound the bf16_master acceptance uses (measured
+    # max |delta| ~0.30 at this seed).
+    assert max(abs(a - b) for a, b in zip(l32, l16)) < 0.6
+    assert l32[-1] < 0.2 and l16[-1] < 0.2  # both overfit
+    fin = runs["fp16_scaled"][1]
+    # The scale stayed healthy end-to-end: never collapsed to the floor
+    # (a run skipping every step would sit at LOSS_SCALE_MIN), and the
+    # metrics stream carried it.
+    from featurenet_tpu.train.precision import LOSS_SCALE_MIN
+
+    assert float(fin.loss_scale) > LOSS_SCALE_MIN
+
+    def preds(state):
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.asarray(batch["voxels"]), train=False,
+        )
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    agreement = (preds(runs["fp32"][1]) == preds(fin)).mean()
+    assert agreement >= 0.967, f"cross-precision agreement {agreement}"
+
+
+def test_loss_scale_skip_is_bitwise_and_scale_recovers(rng):
+    """Loss-scaling edge cases (ISSUE 12 satellite): an overflowed
+    backward — injected by forcing an absurd loss scale, the exact
+    mechanism a too-high scale fails by in production — must (a) skip
+    the update BITWISE (masters, optimizer slots, and BN stats keep
+    their exact bits; only step and scale state move), (b) halve the
+    scale, and (c) recover: subsequent steps halve until finite, then
+    train normally. The growth ladder doubles after
+    LOSS_SCALE_GROWTH_INTERVAL clean steps and is capped."""
+    from featurenet_tpu.train.precision import (
+        LOSS_SCALE_GROWTH_INTERVAL,
+        LOSS_SCALE_MAX,
+    )
+
+    batch = generate_batch(rng, 8, resolution=16)
+    cfg = get_config("smoke16")
+    model = FeatureNet(arch=tiny_arch())
+    tx = make_optimizer(cfg)
+    state = create_state(
+        model, tx, jnp.asarray(batch["voxels"]), jax.random.key(0),
+        precision="fp16_scaled",
+    )
+    step = jax.jit(make_train_step(model, "classify"))  # no donation:
+    # the pre-step state must stay readable for the bitwise compare
+    state, _ = step(state, batch, jax.random.key(1))  # settle one step
+
+    inject = state.replace(loss_scale=jnp.asarray(2.0 ** 30, jnp.float32))
+    before = [np.asarray(x).copy() for x in jax.tree_util.tree_leaves(
+        (inject.params, inject.opt_state, inject.batch_stats))]
+    after, metrics = step(inject, batch, jax.random.key(1))
+    assert float(metrics["grads_finite"]) == 0.0
+    assert float(after.loss_scale) == 2.0 ** 29  # halved
+    assert int(after.good_steps) == 0
+    assert int(after.step) == int(inject.step) + 1  # schedule advances
+    for a, b in zip(before, jax.tree_util.tree_leaves(
+            (after.params, after.opt_state, after.batch_stats))):
+        np.testing.assert_array_equal(a, np.asarray(b))  # bitwise skip
+
+    # Recovery: keep stepping; the scale halves until the f16 backward
+    # survives, then finite steps resume (grads_finite flips to 1).
+    st = after
+    for _ in range(24):
+        st, m = step(st, batch, jax.random.key(1))
+        if float(m["grads_finite"]) == 1.0:
+            break
+    assert float(m["grads_finite"]) == 1.0
+    assert float(st.loss_scale) < 2.0 ** 29
+
+    # Growth: one finite step at the interval boundary doubles the scale
+    # (capped at LOSS_SCALE_MAX) and resets the streak.
+    primed = st.replace(
+        good_steps=jnp.asarray(LOSS_SCALE_GROWTH_INTERVAL - 1, jnp.int32)
+    )
+    grown, m = step(primed, batch, jax.random.key(1))
+    assert float(m["grads_finite"]) == 1.0
+    assert float(grown.loss_scale) == min(
+        float(st.loss_scale) * 2.0, LOSS_SCALE_MAX
+    )
+    assert int(grown.good_steps) == 0
+
+
+def test_loss_scale_state_survives_checkpoint_and_cross_precision(tmp_path):
+    """The skip/scale state rides TrainState: a checkpoint persists the
+    adapted loss scale, restores it into a resumed fp16_scaled run, and
+    round-trips UNTOUCHED through a cross-precision restore (fp16_scaled
+    → fp32 and back) with the masters bitwise-equal throughout."""
+    def run_one(precision, ckpt_dir, total=2):
+        cfg = get_config(
+            "smoke16", train_precision=precision, total_steps=total,
+            checkpoint_every=1, eval_every=10**9, log_every=10**9,
+            data_workers=1, global_batch=8, eval_batches=1,
+            checkpoint_dir=str(ckpt_dir),
+        )
+        t = Trainer(cfg)
+        t.run()
+        return t
+
+    ckpt = tmp_path / "ckpt_fp16"
+    trained = run_one("fp16_scaled", ckpt)
+    from featurenet_tpu.train.precision import LOSS_SCALE_INIT
+
+    scale_disk = float(trained.state.loss_scale)
+    assert scale_disk <= LOSS_SCALE_INIT  # init, or halved by warm-in
+
+    # fp16_scaled → fp32: masters bitwise, scale leaf carried inert.
+    cfg32 = get_config(
+        "smoke16", train_precision="fp32", total_steps=2,
+        checkpoint_every=1, eval_every=10**9, log_every=10**9,
+        data_workers=1, global_batch=8, eval_batches=1,
+        checkpoint_dir=str(ckpt),
+    )
+    t32 = Trainer(cfg32)
+    assert t32.resume_if_available() == 2
+    assert t32.state.precision == "fp32"
+    assert float(t32.state.loss_scale) == scale_disk
+    for a, b in zip(jax.tree_util.tree_leaves(trained.state.params),
+                    jax.tree_util.tree_leaves(t32.state.params)):
+        assert np.asarray(a).dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # … and back: an fp16_scaled resume gets its adapted scale, not a
+    # fresh LOSS_SCALE_INIT.
+    cfg16 = get_config(
+        "smoke16", train_precision="fp16_scaled", total_steps=2,
+        checkpoint_every=1, eval_every=10**9, log_every=10**9,
+        data_workers=1, global_batch=8, eval_batches=1,
+        checkpoint_dir=str(ckpt),
+    )
+    t16 = Trainer(cfg16)
+    assert t16.resume_if_available() == 2
+    assert t16.state.precision == "fp16_scaled"
+    assert float(t16.state.loss_scale) == scale_disk
+    for a, b in zip(jax.tree_util.tree_leaves(trained.state.params),
+                    jax.tree_util.tree_leaves(t16.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fp16_scaled_run_recovers_from_injected_overflow(tmp_path):
+    """fp16 e2e (ISSUE 12 acceptance): a Trainer run whose loss scale is
+    forced into overflow territory mid-flight skips the poisoned step,
+    halves its way back to a survivable scale, and still completes its
+    full step budget with a finite loss."""
+    cfg = get_config(
+        "smoke16", train_precision="fp16_scaled", total_steps=8,
+        eval_every=10**9, checkpoint_every=10**9, log_every=2,
+        data_workers=1, global_batch=8, eval_batches=1,
+        run_dir=str(tmp_path / "run"),
+    )
+    t = Trainer(cfg)
+    t.state = t.state.replace(
+        loss_scale=jnp.asarray(2.0 ** 30, jnp.float32)
+    )
+    last = t.run()
+    assert int(t.state.step) == 8
+    assert np.isfinite(last["loss"])
+    # The injected scale is gone: at least one halving happened and the
+    # run ended at a survivable scale.
+    assert float(t.state.loss_scale) < 2.0 ** 30
+
+
 def test_membytes_master_split_vs_measured_peak():
     """Satellite (ISSUE 10): the HBM byte model knows the master/working
     split — bf16_master costs masters(4)+working(2)+grads(2+4) vs fp32's
@@ -319,6 +514,9 @@ def test_membytes_master_split_vs_measured_peak():
     assert state_bytes(n, "adamw", "fp32") == n * 16
     assert state_bytes(n, "adamw", "bf16_master") == n * 20
     assert state_bytes(n, "sgd", "bf16_master") == n * 16
+    # fp16_scaled shares the split byte-for-byte (f16 == bf16 == 2 bytes;
+    # the loss-scale state is two scalars, not a term).
+    assert state_bytes(n, "adamw", "fp16_scaled") == n * 20
 
     measured = {}
     for prec in ("fp32", "bf16_master"):
