@@ -353,6 +353,18 @@ def _measure_round(platform: str) -> dict:
                 * serving["inferences_per_sec_per_chip"]),
         n_requests=512,
     )
+    # Request-tracing tax (obs.tracing): closed-loop rate through one
+    # warmed service with the sampler fully on vs dark, same session.
+    # Pinned (max) so tracing can never silently grow a hot-path cost;
+    # a failure degrades to an absent key with the error in-artifact,
+    # like the scaling rows — the headline numbers are already paid for.
+    from featurenet_tpu.serve.loadgen import measure_trace_overhead
+
+    trace_row: dict = {}
+    try:
+        trace_row = measure_trace_overhead(cfg)
+    except Exception as e:
+        trace_row = {"trace_overhead_error": repr(e)[:500]}
     # Scaling-efficiency gate rows (the MULTICHIP_r0*.json series made
     # self-policing): per-chip train throughput at every power-of-two
     # mesh shape this session's devices allow, plus the cross-host
@@ -555,9 +567,11 @@ def _measure_round(platform: str) -> dict:
         "paper_arch_mfu": paper["mfu"],
         "paper_arch_spread_pct": paper["spread_pct"],
         # Open-loop serving row (serve.loadgen.bench_serving): sustained
-        # QPS, end-to-end p50/p99 at the target load, mean batch
-        # occupancy of the bucket ladder, overload rejections.
+        # QPS, end-to-end p50/p99 at the target load (server- AND
+        # client-observed), mean batch occupancy of the bucket ladder,
+        # overload rejections.
         **serve_row,
+        **trace_row,
         **scaling_rows,
         **e2e,
     }
@@ -619,7 +633,13 @@ def _measure_round(platform: str) -> dict:
         ("window_queue_depth_p50", 1.0),
         ("serve_p50_ms", 5.0),
         ("serve_p99_ms", 15.0),
+        ("serve_client_p99_ms", 15.0),
         ("serve_rejected", 16.0),
+        # Near-zero by design (tracing is a few buffered dicts and one
+        # sink write per sampled request); relative tolerance on ~0%
+        # would pin "never change" — the gate is for tracing growing a
+        # real hot-path cost, not for run-to-run percent wiggle.
+        ("trace_overhead_pct", 10.0),
         # Near-zero by design on a healthy mesh (hosts fed evenly);
         # relative tolerance on ~0 would pin "never change" — the gate
         # is for a host falling behind by whole percentage points.

@@ -170,6 +170,14 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "serving_p99_ms>20'; default: the built-in rule "
                         "set (data-wait fraction, step p99/median ratio, "
                         "heartbeat age, cross-host data-wait spread)")
+    p.add_argument("--trace-sample", type=float, dest="trace_sample",
+                   help="request-tracing sample rate in [0,1] "
+                        "(featurenet_tpu.obs.tracing): the fraction of "
+                        "healthy serving requests whose admit→dispatch→"
+                        "done timeline lands in the run log (decided by "
+                        "a hash of the trace id, so hosts agree for "
+                        "free); rejections, errors, and SLO breaches "
+                        "are always sampled regardless (default 1.0)")
     p.add_argument("--poll-device-memory", action="store_true",
                    dest="poll_device_memory",
                    help="sample per-device memory_stats() at each "
@@ -240,7 +248,7 @@ def _overrides(args) -> dict:
         "train_precision", "serve_precision",
         "augment_noise", "augment_affine_prob", "augment_ramp_steps",
         "augment_translate_vox", "init_from", "inject_faults",
-        "alert_rules", "exec_cache_dir", "min_world_size",
+        "alert_rules", "exec_cache_dir", "min_world_size", "trace_sample",
         "seg_input_context", "seg_decoder_blocks", "seg_bottleneck_blocks",
     ]
     out = {
@@ -553,6 +561,28 @@ def main(argv=None) -> None:
                        help="event-schema lint: unknown event kinds, "
                             "missing required fields, non-monotonic span "
                             "nesting; exits non-zero on findings")
+    p_rep.add_argument("--request", default=None, metavar="TRACE_ID",
+                       dest="request_trace",
+                       help="render ONE request's admit→dispatch→done "
+                            "timeline (featurenet_tpu.obs.tracing), "
+                            "merged across host streams, with its batch "
+                            "attribution — the id the serving response "
+                            "echoed in the X-Featurenet-Trace header; "
+                            "exits non-zero when the id has no sampled "
+                            "events in this run dir")
+    p_hist = sub.add_parser("bench-history", allow_abbrev=False,
+                            help="one-table summary across BENCH_r*.json "
+                                 "rounds (featurenet_tpu.obs."
+                                 "bench_history): throughput/MFU/serving "
+                                 "pins per round; skipped rounds render "
+                                 "with their structured reason instead "
+                                 "of vanishing")
+    p_hist.add_argument("bench_dir", nargs="?", default=".",
+                        help="directory holding the BENCH_r*.json "
+                             "artifacts (default: the current dir)")
+    p_hist.add_argument("--json", action="store_true", dest="as_json",
+                        help="one JSON object per round instead of the "
+                             "table")
     p_inf = sub.add_parser("infer", allow_abbrev=False,
                            help="classify or segment STL files with a "
                                 "trained checkpoint")
@@ -658,9 +688,16 @@ def main(argv=None) -> None:
                             "unresolved after the final flush (CI "
                             "latency gate); without this flag the drain "
                             "verdict is reported but the exit stays 0")
+    p_srv.add_argument("--trace-sample", type=float, dest="trace_sample",
+                       help="request-tracing sample rate in [0,1] (see "
+                            "`train --trace-sample`); rejections, "
+                            "errors, and SLO breaches are always "
+                            "sampled (default: the checkpoint config's "
+                            "trace_sample, itself 1.0)")
     p_srv.add_argument("--run-dir", dest="run_dir",
                        help="observability directory: serve_batch/"
-                            "overload events, window summaries, alert "
+                            "overload events, per-request trace "
+                            "timelines, window summaries, alert "
                             "fire/resolve pairs (see `cli report`)")
     p_srv.add_argument("--exec-cache-dir", dest="exec_cache_dir",
                        help="persistent AOT executable cache: the bucket "
@@ -700,6 +737,22 @@ def main(argv=None) -> None:
             from featurenet_tpu import obs
 
             obs.close_run()
+        return
+
+    if args.cmd == "bench-history":
+        # Cross-round bench trajectory: stdlib-only, like report — the
+        # table must render where no backend exists.
+        from featurenet_tpu.obs.bench_history import (
+            format_history,
+            load_rounds,
+        )
+
+        rows = load_rounds(args.bench_dir)
+        if args.as_json:
+            for row in rows:
+                print(json.dumps(row))
+        else:
+            print(format_history(rows, bench_dir=args.bench_dir))
         return
 
     if args.cmd == "lint":
@@ -756,6 +809,20 @@ def main(argv=None) -> None:
                 print()  # clean ^C: no traceback over the live view
             return
         events, bad = load_events(args.run_dir)
+        if args.request_trace:
+            from featurenet_tpu.obs.report import (
+                format_request_timeline,
+                request_timeline,
+            )
+
+            tl = request_timeline(events, args.request_trace)
+            if args.as_json:
+                print(json.dumps(tl, indent=1, default=str))
+            else:
+                print(format_request_timeline(tl))
+            if not tl["found"]:
+                raise SystemExit(2)
+            return
         if args.validate:
             findings = validate_events(events, bad_lines=bad)
             for f in findings:
@@ -1306,7 +1373,7 @@ def main(argv=None) -> None:
         from featurenet_tpu.config import get_config
         from featurenet_tpu.infer import Predictor
         from featurenet_tpu.serve.batcher import normalize_buckets
-        from featurenet_tpu.serve.http import make_server
+        from featurenet_tpu.serve.http import _ENDPOINTS, make_server
         from featurenet_tpu.serve.service import InferenceService
         from featurenet_tpu.train.checkpoint import load_run_config
 
@@ -1328,6 +1395,12 @@ def main(argv=None) -> None:
             cfg = get_config(args.config or "pod64")
         if args.exec_cache_dir:
             cfg = _dc.replace(cfg, exec_cache_dir=args.exec_cache_dir)
+        if getattr(args, "trace_sample", None) is not None:
+            # Covers the no-sidecar path; with a sidecar the override
+            # already flowed through _cfg_from_checkpoint (idempotent).
+            cfg = _dc.replace(
+                cfg, trace_sample=args.trace_sample
+            ).validate()
         rules = None  # None → the service installs serve_rules(slo_p99_ms)
         if args.alert_rules:
             from featurenet_tpu.obs.alerts import parse_rules
@@ -1362,7 +1435,8 @@ def main(argv=None) -> None:
             "host": srv.server_address[0], "port": srv.server_address[1],
             "buckets": list(buckets), "max_wait_ms": args.max_wait_ms,
             "queue_limit": args.queue_limit, "precision": pred.precision,
-            "endpoints": ["POST /predict", "GET /stats"],
+            "trace_sample": cfg.trace_sample,
+            "endpoints": _ENDPOINTS,
         }}), flush=True)
         stop = threading.Event()
         prev_handlers = {}

@@ -230,6 +230,18 @@ class Config:
     # and `--follow` — and are never load-bearing. Only meaningful with
     # run_dir (no sink, no windows).
     alert_rules: Optional[str] = None
+    # Request-level tracing sample rate (featurenet_tpu.obs.tracing):
+    # the fraction of HEALTHY serving requests whose admit→dispatch→done
+    # timeline lands in the event stream. The decision is a pure hash of
+    # the trace id (deterministic, so every host and the fleet router
+    # agree for free) and tail-biased: rejections, forward errors, and
+    # requests breaching the serving SLO are ALWAYS sampled regardless
+    # of the rate — at 0.0 the stream still carries every bad request.
+    # 1.0 (default) traces everything; production fleets lower it to
+    # bound log cardinality. Only meaningful with run_dir (no sink, no
+    # events); the measured cost is pinned as trace_overhead_pct in the
+    # bench gate.
+    trace_sample: float = 1.0
     # Persistent AOT executable cache (featurenet_tpu.runtime.cache): when
     # set, every compiled program the runtime registry builds — train
     # steps, eval, serving forwards — is serialized into this directory
@@ -443,6 +455,11 @@ class Config:
             raise ValueError(
                 f"augment_noise is a per-voxel bit-flip probability in "
                 f"[0, 0.5); got {self.augment_noise} (0.01 = 1% of voxels)"
+            )
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ValueError(
+                f"trace_sample is a probability in [0, 1]; got "
+                f"{self.trace_sample}"
             )
         if self.steps_per_dispatch < 1:
             raise ValueError(

@@ -1,0 +1,137 @@
+"""Cross-round bench trajectory: every ``BENCH_r*.json`` in one table.
+
+The bench artifacts are one-file-per-round; reading the trajectory
+means diffing JSON by hand, and a skipped round (r05's TPU outage) just
+*vanishes* from any ad-hoc comparison. ``cli bench-history`` folds the
+whole series into one table — throughput / MFU / serving / open-loop
+serve pins per round — and renders skipped or unparseable rounds with
+their STRUCTURED reason (the ``{"skipped": true, "reason": ...}``
+record the probe hardening writes) instead of dropping them: an outage
+is part of the trajectory, not a gap in it.
+
+Stdlib-only, like the rest of the report path — the history must render
+on a machine with no backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+_ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+# (artifact key, column header, format) — the columns worth reading
+# round-over-round. Keys absent from a round render as "—" (older
+# schemas simply had fewer fields).
+_COLUMNS = (
+    ("value", "sps/chip", "{:.0f}"),
+    ("mfu", "mfu", "{:.2f}"),
+    ("mfu_train", "mfu_meas", "{:.2f}"),
+    ("serving_inferences_per_sec_per_chip", "serve/chip", "{:.0f}"),
+    ("serve_qps_sustained", "qps_open", "{:.0f}"),
+    ("serve_p99_ms", "p99_ms", "{:.1f}"),
+    ("ttfs_warm_s", "ttfs_w", "{:.1f}"),
+    ("trace_overhead_pct", "trace_%", "{:.1f}"),
+)
+
+
+def load_rounds(bench_dir: str = ".") -> list[dict]:
+    """Every ``BENCH_r<N>.json`` in ``bench_dir``, round-ordered, each
+    folded to ``{"round", "status", "reason"?, <column keys>...}``.
+    Three statuses: ``ok`` (a parsed measurement), ``skipped`` (the
+    round recorded its own structured reason), ``unparseable`` (the
+    artifact carries no parsed record at all — rc and the driver's
+    wrapper are the only evidence, e.g. the pre-hardening r05)."""
+    rows: list[dict] = []
+    try:
+        names = os.listdir(bench_dir)
+    except OSError:
+        return rows
+    found = [(m, name) for name in names
+             if (m := _ROUND_RE.match(name))]
+    # Numeric round order, not filename order: BENCH_r10.json must not
+    # sort before BENCH_r2.json (the regex accepts unpadded numbers).
+    found.sort(key=lambda mn: int(mn[0].group(1)))
+    for m, name in found:
+        row: dict = {"round": f"r{int(m.group(1)):02d}"}
+        try:
+            with open(os.path.join(bench_dir, name),
+                      encoding="utf-8") as fh:
+                art = json.load(fh)
+        except (OSError, ValueError) as e:
+            row.update(status="unparseable",
+                       reason=f"artifact unreadable: {e}")
+            rows.append(row)
+            continue
+        # Driver wrapper ({"n", "rc", "parsed", ...}) or a bare bench
+        # record — accept both so a hand-saved round still renders. A
+        # top-level non-dict (a corrupted write that still parses as
+        # JSON) is an unparseable round, not a crash.
+        if not isinstance(art, dict):
+            row.update(status="unparseable",
+                       reason=f"artifact is {type(art).__name__} JSON, "
+                              "not a bench record")
+            rows.append(row)
+            continue
+        parsed = art.get("parsed") if "parsed" in art else art
+        if not isinstance(parsed, dict):
+            row.update(
+                status="unparseable",
+                reason=f"no parseable bench record (driver rc="
+                       f"{art.get('rc')})",
+            )
+        elif parsed.get("skipped"):
+            row.update(status="skipped",
+                       reason=str(parsed.get("reason")))
+            if parsed.get("error"):
+                row["error"] = str(parsed["error"])[:200]
+        else:
+            row["status"] = "ok"
+            for key, _, _ in _COLUMNS:
+                if isinstance(parsed.get(key), (int, float)):
+                    row[key] = parsed[key]
+            gate = parsed.get("gate")
+            if isinstance(gate, dict) and "ok" in gate:
+                row["gate_ok"] = bool(gate["ok"])
+                if gate.get("failed"):
+                    row["gate_failed"] = list(gate["failed"])
+        rows.append(row)
+    return rows
+
+
+def format_history(rows: list[dict],
+                   bench_dir: Optional[str] = None) -> str:
+    """One table across rounds; skipped/unparseable rounds keep their
+    line (reason in place of numbers) so the trajectory reads complete."""
+    if not rows:
+        return (
+            f"bench-history: no BENCH_r*.json artifacts"
+            + (f" in {bench_dir!r}" if bench_dir else "")
+        )
+    head = f"{'round':<6} {'status':<8}" + "".join(
+        f" {hdr:>10}" for _, hdr, _ in _COLUMNS
+    ) + "  gate"
+    lines = [head]
+    for row in rows:
+        if row["status"] != "ok":
+            lines.append(
+                f"{row['round']:<6} {row['status']:<8} "
+                f"{row.get('reason')}"
+            )
+            continue
+        cells = []
+        for key, _, fmt in _COLUMNS:
+            v = row.get(key)
+            cells.append(
+                f" {fmt.format(v) if v is not None else '—':>10}"
+            )
+        gate = ("—" if "gate_ok" not in row
+                else "ok" if row["gate_ok"]
+                else "FAIL " + ",".join(row.get("gate_failed", [])))
+        lines.append(
+            f"{row['round']:<6} {row['status']:<8}" + "".join(cells)
+            + f"  {gate}"
+        )
+    return "\n".join(lines)

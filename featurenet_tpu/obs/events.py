@@ -131,6 +131,11 @@ class EventSink:
         self._lock = threading.Lock()
         self._pid = os.getpid()
         self._emits = 0
+        # Per-kind emit counts for the /metrics exporter: the sink sees
+        # every event this process records, so counting here folds the
+        # whole telemetry surface (compiles, cache verdicts, overloads)
+        # into scrape-able counters with no second bookkeeping layer.
+        self._kind_counts: dict[str, int] = {}
         # Raw fd, O_APPEND: every emit below is exactly one os.write of one
         # complete line. POSIX append semantics make each such write land
         # at the (atomically advanced) end of file, so concurrent writers
@@ -157,6 +162,7 @@ class EventSink:
             if self._fd is None:
                 return
             self._emits += 1
+            self._kind_counts[ev] = self._kind_counts.get(ev, 0) + 1
             # Telemetry is never load-bearing: a write that fails at the
             # OS level (ENOSPC, quota, a yanked network filesystem) must
             # not crash training. Degrade to a no-op sink with exactly one
@@ -240,9 +246,15 @@ def init_run(run_dir: str, config: Optional[dict] = None,
                 # Switching runs: the old run's final window cycle goes
                 # into the OLD stream, then the aggregator is dropped —
                 # run B's first summary must come from run B's samples
-                # (and run B's rules), not run A's ring buffers.
+                # (and run B's rules), not run A's ring buffers. The
+                # tracing counters reset with it: run B's /metrics must
+                # not report run A's sampled-request totals (the fresh
+                # sink already zeroes the per-kind counts beside them).
+                from featurenet_tpu.obs import tracing as _tracing
+
                 _windows.flush()
                 _windows.uninstall()
+                _tracing.reset_counters()
                 _sink.close()
             _sink = EventSink(target, filename=filename)
         _windows.ensure_default()
@@ -260,6 +272,17 @@ def init_run(run_dir: str, config: Optional[dict] = None,
 
 def active() -> bool:
     return _sink is not None
+
+
+def kind_counts() -> dict[str, int]:
+    """Per-kind emit counts of the active sink (empty when dark) — the
+    /metrics exporter's source for compiles / cache verdicts / serving
+    events without a second counting layer anywhere."""
+    s = _sink
+    if s is None:
+        return {}
+    with s._lock:
+        return dict(s._kind_counts)
 
 
 def emit(ev: str, **fields) -> None:
@@ -291,14 +314,18 @@ def warn(name: str, msg: str, **fields) -> None:
 
 def close_run() -> None:
     global _sink
+    from featurenet_tpu.obs import tracing as _tracing
     from featurenet_tpu.obs import windows as _windows
 
     # Flush pending window summaries (and their alert evaluation) while
     # the sink can still write them, then drop the aggregator with the
-    # sink — obs state must never leak across runs in one process.
+    # sink — obs state must never leak across runs in one process. The
+    # tracing counters are ambient obs state like the aggregator: run
+    # B's /metrics must not report run A's sampled-request counts.
     if _sink is not None:
         _windows.flush()
     _windows.uninstall()
+    _tracing.reset_counters()
     with _install_lock:
         if _sink is not None:
             _sink.close()

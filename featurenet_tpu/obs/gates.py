@@ -117,6 +117,16 @@ DIRECTIONS = {
     "serve_p99_ms": "max",
     "serve_occupancy": "min",
     "serve_rejected": "max",
+    # Client-observed open-loop latency (loadgen's own clock): regresses
+    # upward like the server-side percentiles; the p99 gap between the
+    # two is queueing upstream of admission.
+    "serve_client_p99_ms": "max",
+    # Request-tracing tax (serve.loadgen.measure_trace_overhead):
+    # sampled-on vs dark closed-loop rate through one warmed service.
+    # Regresses UPWARD — tracing must never silently grow a hot-path
+    # cost; the pin is what enforces "never load-bearing" as a measured
+    # property rather than a docstring claim.
+    "trace_overhead_pct": "max",
     # Scaling-efficiency gate (the MULTICHIP_r0*.json series made
     # self-policing): per-chip train throughput at each power-of-two
     # data-mesh shape (benchmark.measure_scaling) regresses DOWNWARD,
@@ -226,8 +236,10 @@ BENCH_GATE_KEYS = (
     "serve_qps_sustained",
     "serve_p50_ms",
     "serve_p99_ms",
+    "serve_client_p99_ms",
     "serve_occupancy",
     "serve_rejected",
+    "trace_overhead_pct",
     # Scaling-efficiency gate: samples/sec per mesh shape plus the
     # cross-host data-wait spread of the 2-host probe run — present only
     # when the round could measure them (device count / probe success),

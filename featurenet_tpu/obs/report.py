@@ -461,6 +461,136 @@ def _perf_section(events: list[dict], slo: dict) -> dict:
     return out
 
 
+def _traces_section(events: list[dict],
+                    trace_sample: float = 1.0) -> dict:
+    """Fold the sampled per-request timelines (obs.tracing) into the
+    answers an operator asks of the tail: how many requests were
+    sampled/forced, which ten were slowest (WITH their batch
+    attribution — the bucket and dispatch they rode), and how far the
+    client-observed latency sits above the server's own (``loadgen``
+    summary vs the sampled ``request_done`` p99: the skew is queueing
+    upstream of admission, measured on one clock). Every host's stream
+    counts — a fleet's requests land wherever they were served.
+
+    ``trace_sample`` is the rate the run was configured with (from the
+    manifest): below 1.0 the sampled ``request_done`` set is tail-
+    biased BY DESIGN (forced slow/failed requests stay, healthy ones
+    drop), so its percentiles overstate the true server latency — they
+    are labeled as sample-biased and the client-vs-server skew is
+    suppressed rather than reported against a biased denominator."""
+    done = [e for e in events if e["ev"] == "request_done"]
+    rejects = [e for e in events if e["ev"] == "request_reject"]
+    if not done and not rejects:
+        return {}
+    out: dict = {
+        "sampled": len(done),
+        "rejected": len(rejects),
+        "forced": sum(1 for e in done if e.get("forced"))
+        + len(rejects),
+        "errors": sum(1 for e in done if e.get("outcome") == "error"),
+    }
+    # Batch attribution per trace: the dispatch event carries the seq /
+    # bucket / pad the request rode (last one wins — retries don't exist
+    # today, but a re-dispatched future would be the interesting one).
+    disp: dict[str, dict] = {}
+    for e in events:
+        if e["ev"] == "request_dispatch" and e.get("trace"):
+            disp[e["trace"]] = e
+    slowest = sorted(
+        done, key=lambda e: e.get("total_ms") or 0.0, reverse=True
+    )[:10]
+    out["slowest"] = [
+        {
+            "trace": e.get("trace"),
+            "total_ms": e.get("total_ms"),
+            "queue_wait_ms": e.get("queue_wait_ms"),
+            "dispatch_ms": e.get("dispatch_ms"),
+            "outcome": e.get("outcome"),
+            "batch_seq": (disp.get(e.get("trace")) or {}).get("batch_seq"),
+            "bucket": (disp.get(e.get("trace")) or {}).get("bucket"),
+        }
+        for e in slowest
+    ]
+    complete = trace_sample >= 1.0
+    if not complete:
+        out["sample_rate"] = trace_sample
+        out["sample_biased"] = True
+    totals = sorted(
+        e["total_ms"] for e in done
+        if isinstance(e.get("total_ms"), (int, float))
+    )
+    if totals:
+        out["server_p50_ms"] = round(_pct(totals, 50), 3)
+        out["server_p99_ms"] = round(_pct(totals, 99), 3)
+    lg = [e for e in events if e["ev"] == "loadgen"]
+    if lg:
+        last = lg[-1]
+        client = {
+            k: last.get(k) for k in ("n", "client_p50_ms", "client_p99_ms")
+        }
+        if complete and totals \
+                and isinstance(last.get("client_p99_ms"), (int, float)):
+            client["skew_p99_ms"] = round(
+                last["client_p99_ms"] - _pct(totals, 99), 3
+            )
+        out["client"] = client
+    return out
+
+
+def request_timeline(events: list[dict], trace_id: str) -> dict:
+    """One request's admit→dispatch→done (or reject) timeline, merged
+    across every host stream and time-ordered. Returns ``{"trace",
+    "found", "events": [...]}`` where each row carries its host, the
+    offset from the first event, and the kind-specific fields — the
+    answer to "what happened to THIS request"."""
+    rows = sorted(
+        (e for e in events
+         if e.get("ev") in REQUEST_EVENT_KINDS
+         and e.get("trace") == trace_id),
+        key=lambda e: e["t"],
+    )
+    if not rows:
+        return {"trace": trace_id, "found": False, "events": []}
+    t0 = rows[0]["t"]
+    return {
+        "trace": trace_id,
+        "found": True,
+        "events": [
+            {
+                "event": e["ev"],
+                "t": round(e["t"], 6),
+                "offset_ms": round((e["t"] - t0) * 1e3, 3),
+                "host": int(e.get("process_index") or 0),
+                **{k: v for k, v in e.items()
+                   if k not in ("ev", "t", "trace", "pid",
+                                "process_index", "thread")},
+            }
+            for e in rows
+        ],
+    }
+
+
+def format_request_timeline(tl: dict) -> str:
+    """Human rendering of ``request_timeline`` (the CLI's ``--request``
+    output)."""
+    if not tl["found"]:
+        return (
+            f"trace {tl['trace']}: no events in this run dir — the id "
+            "may be wrong, or the request fell outside the sampling "
+            "rate (rejections, errors, and SLO breaches are always "
+            "sampled; healthy traffic at Config.trace_sample)"
+        )
+    lines = [f"trace {tl['trace']}"]
+    for e in tl["events"]:
+        detail = {k: v for k, v in e.items()
+                  if k not in ("event", "t", "offset_ms", "host")}
+        lines.append(
+            f"  +{e['offset_ms']:>9.3f} ms  host {e['host']}  "
+            f"{e['event']:<16} {detail or ''}"
+        )
+    return "\n".join(lines)
+
+
 def build_report(events: list[dict], manifest: Optional[dict] = None,
                  bad_lines: int = 0) -> dict:
     by_host: dict[int, list[dict]] = {}
@@ -679,6 +809,15 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             serve["served"] = stops[-1].get("served")
             serve["rejected"] = stops[-1].get("rejected")
         rep["serve"] = serve
+
+    # --- request-level traces (obs.tracing) ----------------------------------
+    ts_rate = ((manifest or {}).get("config") or {}).get("trace_sample")
+    traces = _traces_section(
+        events,
+        trace_sample=ts_rate if isinstance(ts_rate, (int, float)) else 1.0,
+    )
+    if traces:
+        rep["traces"] = traces
 
     # --- warnings / metrics -------------------------------------------------
     # Warnings aggregate across every host (a warning on host 3 must not
@@ -966,6 +1105,43 @@ def format_report(rep: dict) -> str:
                     f"{k}×{v}" for k, v in se["by_bucket"].items()
                 )
             )
+    tr = rep.get("traces")
+    if tr:
+        lines.append(
+            f"traces: {tr['sampled']} sampled request(s) "
+            f"({tr['forced']} forced: rejects/errors/SLO breaches)"
+            + (f", {tr['rejected']} reject(s)" if tr.get("rejected")
+               else "")
+            + (f"; server p50/p99 {tr.get('server_p50_ms')}/"
+               f"{tr.get('server_p99_ms')} ms"
+               + (" (tail-biased sample — rate "
+                  f"{tr['sample_rate']}, overstates the true tail)"
+                  if tr.get("sample_biased") else "")
+               if tr.get("server_p99_ms") is not None else "")
+        )
+        cl = tr.get("client")
+        if cl:
+            lines.append(
+                f"  client (loadgen): p50 {cl.get('client_p50_ms')} ms "
+                f"p99 {cl.get('client_p99_ms')} ms"
+                + (f", p99 skew over server {cl['skew_p99_ms']} ms"
+                   if cl.get("skew_p99_ms") is not None else "")
+            )
+        if tr.get("slowest"):
+            lines.append(
+                "  slowest    trace             total     queue  "
+                "dispatch  batch  bucket  outcome"
+            )
+            for row in tr["slowest"]:
+                lines.append(
+                    f"    {str(row.get('trace')):<16}  "
+                    f"{row.get('total_ms') or 0:>8.3f}  "
+                    f"{row.get('queue_wait_ms') or 0:>8.3f}  "
+                    f"{row.get('dispatch_ms') or 0:>8.3f}  "
+                    f"{str(row.get('batch_seq') or '—'):>5}  "
+                    f"{str(row.get('bucket') or '—'):>6}  "
+                    f"{row.get('outcome')}"
+                )
     w = rep.get("warnings")
     if w:
         lines.append(
@@ -1191,6 +1367,17 @@ KNOWN_EVENT_KINDS = frozenset({
     # on re-admission), and the per-slot transitions — a host charged as
     # lost, a recovered host re-admitted at a generation boundary.
     "mesh_reform", "host_leave", "host_join",
+    # Request-level tracing (obs.tracing): the per-request serving
+    # timeline — admitted into the queue, dispatched on a batch
+    # (batch_seq ties it to its serve_dispatch span), completed with the
+    # queue/device split, or fast-rejected at the admission bound.
+    # Tail-biased sampled: rejections, errors, and SLO breaches are
+    # always present; healthy traffic at the Config.trace_sample rate.
+    "request_admit", "request_dispatch", "request_done", "request_reject",
+    # The open-loop load generator's client-side summary: what the
+    # CALLER observed (client p50/p99 vs the server's serving_ms windows
+    # — the skew between them is real queueing, measured on one clock).
+    "loadgen",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -1216,13 +1403,24 @@ REQUIRED_EVENT_FIELDS = {
     "cache_miss": ("program",),
     "cache_reject": ("program", "reason"),
     "serve_start": ("buckets", "max_wait_ms", "queue_limit"),
-    "serve_batch": ("bucket", "n"),
+    "serve_batch": ("bucket", "n", "batch_seq"),
     "overload": ("queue_depth", "limit"),
     "serve_stop": ("served", "rejected"),
     "mesh_reform": ("generation", "from_n", "to_n", "reason"),
     "host_leave": ("host", "generation", "reason"),
     "host_join": ("host", "generation"),
+    "request_admit": ("trace",),
+    "request_dispatch": ("trace", "batch_seq", "bucket", "pad"),
+    "request_done": ("trace", "queue_wait_ms", "dispatch_ms", "total_ms",
+                     "outcome"),
+    "request_reject": ("trace", "queue_depth", "limit"),
+    "loadgen": ("n", "client_p50_ms", "client_p99_ms"),
 }
+
+# The event kinds that carry a per-request ``trace`` id — the timeline
+# view (``cli report --request``) and the traces section key off this.
+REQUEST_EVENT_KINDS = ("request_admit", "request_dispatch",
+                       "request_done", "request_reject")
 
 # Required at EMIT sites (the analysis linter holds new code to the full
 # tuples above) but tolerated as absent by ``validate_events``: archived
@@ -1230,6 +1428,8 @@ REQUIRED_EVENT_FIELDS = {
 # legacy fallbacks the report sections already implement.
 LEGACY_OPTIONAL_FIELDS = {
     "alert": ("state",),  # pre-hysteresis streams re-fired with no state
+    # pre-tracing serve streams carried no dispatch sequence number
+    "serve_batch": ("batch_seq",),
 }
 
 # Wall-clock start stamps vs perf_counter durations: a parent records its

@@ -110,15 +110,26 @@ def chrome_trace(events: list[dict]) -> dict:
     above the span lanes they summarize (the ``mfu`` window rides this
     path: an MFU counter track for free). ``device_memory`` events (the
     obs.perf heartbeat-cadence poller) export as one counter track per
-    device — the HBM watermark next to the spans that caused it."""
+    device — the HBM watermark next to the spans that caused it.
+
+    Sampled request traces (obs.tracing) export as ASYNC events — one
+    ``b``/``e`` pair per trace id spanning admit→done (or →reject), with
+    an instant at the dispatch point — plus a flow arrow ("ph":"s"/"f")
+    from the admit to the dispatch, so a batch's N fanned-in requests
+    are visually tied to the ``serve_dispatch`` span carrying the same
+    ``batch_seq``."""
     spans = [e for e in events if e.get("ev") == "span" and "dur_s" in e]
     windows = [e for e in events
                if e.get("ev") == "window_summary" and "metric" in e]
     mem = [e for e in events
            if e.get("ev") == "device_memory" and "bytes_in_use" in e]
-    if not spans and not windows and not mem:
+    reqs = [e for e in events
+            if e.get("ev") in ("request_admit", "request_dispatch",
+                               "request_done", "request_reject")
+            and "trace" in e]
+    if not spans and not windows and not mem and not reqs:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    t0 = min(e["t"] for e in spans + windows + mem)
+    t0 = min(e["t"] for e in spans + windows + mem + reqs)
     track_ids: dict[tuple, int] = {}
 
     def track(e: dict) -> int:
@@ -160,6 +171,48 @@ def chrome_trace(events: list[dict]) -> dict:
                 if isinstance(e.get(k), (int, float))
             },
         })
+    # Async request lanes + flow arrows (one lane per sampled trace id;
+    # the b/e pair spans the request's whole server-side life, the flow
+    # links its admit point into the dispatch that served it).
+    _ASYNC_PH = {"request_admit": "b", "request_done": "e",
+                 "request_reject": "e"}
+    by_trace: dict[str, list[dict]] = {}
+    for e in reqs:
+        by_trace.setdefault(str(e["trace"]), []).append(e)
+    for trace, evs in sorted(by_trace.items()):
+        evs.sort(key=lambda e: e["t"])
+        for e in evs:
+            ph = _ASYNC_PH.get(e["ev"], "n")
+            out.append({
+                "name": "request",
+                "cat": "request",
+                "ph": ph,
+                "id": trace,
+                "ts": (e["t"] - t0) * 1e6,
+                "pid": track(e),
+                "tid": e.get("thread", 0),
+                "args": {
+                    k: e[k] for k in ("batch_seq", "bucket", "pad",
+                                      "queue_wait_ms", "dispatch_ms",
+                                      "total_ms", "outcome")
+                    if e.get(k) is not None
+                },
+            })
+        admit = next((e for e in evs if e["ev"] == "request_admit"), None)
+        disp = next((e for e in evs if e["ev"] == "request_dispatch"),
+                    None)
+        if admit is not None and disp is not None:
+            for e, ph in ((admit, "s"), (disp, "f")):
+                out.append({
+                    "name": "request-flow",
+                    "cat": "request",
+                    "ph": ph,
+                    "id": trace,
+                    "ts": (e["t"] - t0) * 1e6,
+                    "pid": track(e),
+                    "tid": e.get("thread", 0),
+                    **({"bp": "e"} if ph == "f" else {}),
+                })
     meta = []
     for (host, ospid), tpid in sorted(track_ids.items(), key=lambda kv: kv[1]):
         meta.append({

@@ -1,0 +1,229 @@
+"""Request-scoped distributed tracing for the serving path.
+
+The serving telemetry so far is *aggregate*: window percentiles, SLO
+alerts, batch occupancy. None of it can answer "what happened to THIS
+request" — which batch it rode, how long it queued vs sat on device, or
+why a client's observed latency disagrees with the server's
+``serving_ms``. This module is the per-request causality layer:
+
+- A **trace id** is minted at admission (or accepted from the caller —
+  the ``X-Featurenet-Trace`` HTTP header, the propagation hook a fleet
+  router uses to follow one request across a process hop) and echoed in
+  the response. Ids are 16 hex chars; a caller-supplied id is accepted
+  when it matches ``_ID_RE`` (≤64 chars of ``[A-Za-z0-9._-]``) and
+  replaced with a minted one otherwise — a hostile header must not be
+  able to inject arbitrary bytes into the event stream.
+- The batcher stamps each ``PendingRequest`` with its ``TraceContext``
+  and records ``request_admit`` / ``request_dispatch`` /
+  ``request_done`` / ``request_reject`` events into the existing JSONL
+  streams. One dispatch fans in N trace ids; the de-mux fans them back
+  out, so the merged log reconstructs a per-request server-side
+  timeline (``cli report --request <id>``).
+- **Tail-biased sampling** bounds cardinality: events are *buffered* on
+  the context and the keep/drop decision is made at completion, when
+  the outcome is known — so rejections, errors, and SLO-breaching
+  requests are ALWAYS kept while healthy traffic is downsampled to
+  ``Config.trace_sample``. The rate decision is a pure hash of the
+  trace id (``sampled``), so every host — and the future fleet router —
+  agrees on it with no coordination.
+
+Overhead discipline: with no event sink installed nothing is buffered
+(one ``None`` check per hook, the obs layer's standing contract), and
+the minted id costs one ``os.urandom`` read. The measured cost of the
+sampled-on path is pinned in the bench gate (``trace_overhead_pct``),
+so tracing can never silently tax the hot path. Telemetry is never
+load-bearing: every write goes through the degrading event sink.
+
+Stdlib-only, like the rest of the obs package.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import threading
+import time
+from typing import Optional
+
+from featurenet_tpu.obs import events as _events
+
+# The HTTP propagation header: accepted on the request, echoed on every
+# response (200s, overload 503s, even 400s — the caller keyed its own
+# bookkeeping off the id it sent).
+TRACE_HEADER = "X-Featurenet-Trace"
+
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+# Outcomes a request_done event may carry. "ok" is downsampled by rate;
+# "error" is always kept (tail bias).
+OUTCOMES = ("ok", "error")
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id (64 random bits — collision-free at
+    any realistic request volume, and cheap enough for the hot path)."""
+    return os.urandom(8).hex()
+
+
+def normalize_trace_id(raw: Optional[str]) -> str:
+    """A usable trace id from caller input: the supplied id when it is
+    well-formed (``_ID_RE``), a minted one otherwise (including None —
+    the common no-header case)."""
+    if raw and _ID_RE.match(raw):
+        return raw
+    return mint_trace_id()
+
+
+def sampled(trace_id: str, rate: float) -> bool:
+    """The deterministic rate decision: a pure hash of the trace id
+    against ``rate``, so two processes (or two hosts, or the router and
+    the replica) always agree on whether a given id is sampled — cross-
+    host agreement is free, no coordination channel needed. Forced
+    samples (rejects / errors / SLO breaches) bypass this entirely."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = int.from_bytes(
+        hashlib.sha256(trace_id.encode("utf-8")).digest()[:8], "big"
+    )
+    return h / float(1 << 64) < rate
+
+
+class TraceContext:
+    """One request's trace state: its id plus the buffered events the
+    tail-biased sampler will flush (or drop) at completion. ``_events``
+    is None when no sink was active at admission — the dark path
+    allocates nothing beyond the context itself."""
+
+    __slots__ = ("trace_id", "sample_rate", "_buffered", "_finished")
+
+    def __init__(self, trace_id: str, sample_rate: float):
+        self.trace_id = trace_id
+        self.sample_rate = float(sample_rate)
+        self._buffered: Optional[list[dict]] = (
+            [] if _events.active() else None
+        )
+        self._finished = False
+
+
+# Process-wide sampling counters for the /metrics exporter ("how much of
+# my traffic is traced" is a scrape-able scaling signal). Reset with the
+# run (obs.close_run), like every other piece of ambient obs state.
+_counters = {"admitted": 0, "done": 0, "sampled": 0, "forced": 0,
+             "rejected": 0}
+_counters_lock = threading.Lock()
+
+
+def counters() -> dict:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+def admit(trace_id: Optional[str] = None,
+          sample_rate: float = 1.0) -> TraceContext:
+    """Mint (or adopt) a trace context at the admission point and buffer
+    its ``request_admit`` event. Called by the batcher's ``submit`` —
+    the one place every serving request passes through."""
+    ctx = TraceContext(normalize_trace_id(trace_id), sample_rate)
+    with _counters_lock:
+        _counters["admitted"] += 1
+    if ctx._buffered is not None:
+        ctx._buffered.append({
+            "kind": "request_admit",
+            "t": time.time(),
+            "thread": threading.get_ident(),
+        })
+    return ctx
+
+
+def dispatch(ctx: Optional[TraceContext], batch_seq: int, bucket: int,
+             pad: int) -> None:
+    """Buffer the ``request_dispatch`` event: this request left the
+    queue on batch ``batch_seq``, padded into ``bucket``. The batch
+    attribution is what ties N fanned-in trace ids to one
+    ``serve_dispatch`` span (which carries the same ``batch_seq``)."""
+    if ctx is None or ctx._buffered is None:
+        return
+    ctx._buffered.append({
+        "kind": "request_dispatch",
+        "t": time.time(),
+        "batch_seq": int(batch_seq),
+        "bucket": int(bucket),
+        "pad": int(pad),
+        "thread": threading.get_ident(),
+    })
+
+
+def _flush_buffered(ctx: TraceContext) -> None:
+    """Emit the buffered admit/dispatch events with their ORIGINAL
+    timestamps (the sampler decided late; the timeline must not lie
+    about when things happened). Explicit per-kind emits so the
+    telemetry lint can check each kind's required fields statically."""
+    for rec in ctx._buffered or ():
+        if rec["kind"] == "request_admit":
+            _events.emit("request_admit", t=rec["t"], trace=ctx.trace_id,
+                         thread=rec["thread"])
+        elif rec["kind"] == "request_dispatch":
+            _events.emit("request_dispatch", t=rec["t"],
+                         trace=ctx.trace_id, batch_seq=rec["batch_seq"],
+                         bucket=rec["bucket"], pad=rec["pad"],
+                         thread=rec["thread"])
+    ctx._buffered = []
+
+
+def reject(ctx: Optional[TraceContext], queue_depth: int,
+           limit: int) -> None:
+    """An admission fast-reject: ALWAYS sampled (a rejection is exactly
+    the request an operator goes looking for), flushed immediately —
+    there is no later completion point to defer to."""
+    if ctx is None or ctx._finished:
+        return
+    ctx._finished = True
+    with _counters_lock:
+        _counters["rejected"] += 1
+        _counters["forced"] += 1
+    if ctx._buffered is None:
+        return
+    _flush_buffered(ctx)
+    _events.emit("request_reject", trace=ctx.trace_id,
+                 queue_depth=int(queue_depth), limit=int(limit))
+
+
+def done(ctx: Optional[TraceContext], queue_wait_ms: float,
+         dispatch_ms: float, total_ms: float, outcome: str = "ok",
+         slo_ms: Optional[float] = None) -> None:
+    """Completion: decide the tail-biased sample and flush or drop the
+    buffered timeline. Forced (always kept) when the outcome is an
+    error or the request breached the serving SLO — the tail IS the
+    point; healthy traffic falls to the deterministic rate decision."""
+    if ctx is None or ctx._finished:
+        return
+    ctx._finished = True
+    forced = outcome != "ok" or (
+        slo_ms is not None and total_ms > slo_ms
+    )
+    keep = forced or sampled(ctx.trace_id, ctx.sample_rate)
+    with _counters_lock:
+        _counters["done"] += 1
+        if keep:
+            _counters["sampled"] += 1
+        if forced:
+            _counters["forced"] += 1
+    if not keep or ctx._buffered is None:
+        ctx._buffered = None
+        return
+    _flush_buffered(ctx)
+    _events.emit("request_done", trace=ctx.trace_id,
+                 queue_wait_ms=round(float(queue_wait_ms), 3),
+                 dispatch_ms=round(float(dispatch_ms), 3),
+                 total_ms=round(float(total_ms), 3),
+                 outcome=outcome,
+                 forced=forced)

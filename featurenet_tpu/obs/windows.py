@@ -180,6 +180,26 @@ class WindowAggregator:
                 m for m, on in self._alert_active.items() if on
             )
 
+    def snapshot(self) -> dict:
+        """Current summary per metric with samples (the same numbers a
+        ``window_summary`` emission would carry) — the live read the
+        ``/metrics`` exporter scrapes, so external monitors and the SLO
+        alerts judge the SAME windows."""
+        now = time.perf_counter()
+        with self._lock:
+            out = {}
+            for metric, win in self._win.items():
+                s = win.summary(now)
+                if s is not None:
+                    out[metric] = s
+            return out
+
+    @property
+    def seq(self) -> int:
+        """The latest emission sequence number (0 = none yet)."""
+        with self._lock:
+            return self._seq
+
     def rule_value(self, metric: str, now: float) -> Optional[float]:
         """Resolve a rule metric against the current windows: a derived
         metric, or ``<window>_<stat>`` percentile lookup. None when the
@@ -283,3 +303,22 @@ def active_alerts() -> list[str]:
     if agg is None:
         return []
     return agg.active_alerts()
+
+
+def snapshot() -> dict:
+    """Live window summaries of the installed aggregator (empty when
+    none) — the ``/metrics`` exporter's source."""
+    agg = _agg
+    if agg is None:
+        return {}
+    return agg.snapshot()
+
+
+def last_seq() -> Optional[int]:
+    """The installed aggregator's latest emission seq (None when none is
+    installed; 0 before the first emission) — ``/healthz`` surfaces it
+    so a monitor can tell a fresh server from one whose windows moved."""
+    agg = _agg
+    if agg is None:
+        return None
+    return agg.seq

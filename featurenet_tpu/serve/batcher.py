@@ -35,6 +35,14 @@ request feeds ``queue_wait_ms`` (enqueue → dispatch) and ``serving_ms``
 (enqueue → response, the end-to-end latency an SLO is written against)
 into the rolling windows; each dispatch emits a ``serve_batch`` event and
 a ``serve_dispatch`` span.
+
+Request tracing (``obs.tracing``): ``submit`` mints (or adopts) a trace
+context per request; the dispatcher stamps each one with the batch it
+rode (``batch_seq`` — the same sequence number the ``serve_batch`` event
+and ``serve_dispatch`` span carry, so one dispatch's N fanned-in trace
+ids tie back to it) and completes the timeline at de-mux with the
+queue/device split. Sampling is tail-biased: rejections, forward errors,
+and requests breaching ``trace_slo_ms`` are always kept.
 """
 
 from __future__ import annotations
@@ -47,6 +55,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from featurenet_tpu import obs
+from featurenet_tpu.obs import tracing as _tracing
 from featurenet_tpu.obs import windows as _windows
 
 DEFAULT_BUCKETS = (1, 4, 16, 64)
@@ -60,10 +69,16 @@ class OverloadError(RuntimeError):
     unbounded wait. ``response`` is the wire shape the HTTP front end
     returns with a 503."""
 
-    def __init__(self, queue_depth: int, limit: int):
+    def __init__(self, queue_depth: int, limit: int,
+                 trace_id: Optional[str] = None):
         super().__init__(f"serving queue full ({queue_depth}/{limit})")
         self.queue_depth = int(queue_depth)
         self.limit = int(limit)
+        # The rejected request's trace id (echoed by the HTTP layer so
+        # the caller can correlate the 503 with its own bookkeeping; the
+        # wire `response` shape is unchanged — load balancers key off
+        # structure that predates tracing).
+        self.trace_id = trace_id
 
     @property
     def response(self) -> dict:
@@ -99,15 +114,25 @@ class PendingRequest:
     """One enqueued request: a future the batcher resolves with this
     request's own output row (or the batch's forward error)."""
 
-    __slots__ = ("voxels", "t_enq", "t_done", "value", "error", "_event")
+    __slots__ = ("voxels", "t_enq", "t_done", "value", "error", "_event",
+                 "ctx")
 
-    def __init__(self, voxels: np.ndarray):
+    def __init__(self, voxels: np.ndarray,
+                 ctx: Optional[_tracing.TraceContext] = None):
         self.voxels = voxels
         self.t_enq = time.perf_counter()
         self.t_done: Optional[float] = None
         self.value = None
         self.error: Optional[BaseException] = None
         self._event = threading.Event()
+        # Request-scoped trace context (obs.tracing): carries the id the
+        # HTTP layer echoes and the buffered timeline the tail-biased
+        # sampler flushes at completion.
+        self.ctx = ctx
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.ctx.trace_id if self.ctx is not None else None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -145,7 +170,9 @@ class ContinuousBatcher:
                  max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
                  queue_limit: int = DEFAULT_QUEUE_LIMIT,
                  cost_for: Optional[Callable] = None,
-                 peaks: Optional[dict] = None):
+                 peaks: Optional[dict] = None,
+                 trace_sample: float = 1.0,
+                 trace_slo_ms: Optional[float] = None):
         bs = normalize_buckets(buckets)
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
@@ -160,6 +187,17 @@ class ContinuousBatcher:
         # a bare-CPU test with a fake forward observes nothing.
         self.cost_for = cost_for
         self.peaks = peaks
+        # Request tracing (obs.tracing): the healthy-traffic sampling
+        # rate (a pure hash of the trace id — multi-host agreement is
+        # free) and the SLO threshold above which a request is ALWAYS
+        # sampled regardless of rate (tail bias: the slow tail is the
+        # point of tracing).
+        if not (0.0 <= trace_sample <= 1.0):
+            raise ValueError(
+                f"trace_sample must be in [0, 1], got {trace_sample}"
+            )
+        self.trace_sample = float(trace_sample)
+        self.trace_slo_ms = trace_slo_ms
         self.buckets = bs
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.queue_limit = int(queue_limit)
@@ -173,6 +211,11 @@ class ContinuousBatcher:
         self._batches = 0
         self._rows = 0
         self._capacity = 0
+        # Dispatch sequence number (batch attribution for tracing):
+        # incremented by the single dispatcher thread only, carried by
+        # serve_batch / serve_dispatch / request_dispatch so one batch's
+        # fanned-in trace ids all name the same dispatch.
+        self._batch_seq = 0
         self._by_bucket: dict[int, int] = {}
         self._worker = threading.Thread(
             target=self._run, name="serve-batcher", daemon=True
@@ -180,16 +223,24 @@ class ContinuousBatcher:
         self._worker.start()
 
     # -- producer side -------------------------------------------------------
-    def submit(self, voxels: np.ndarray) -> PendingRequest:
+    def submit(self, voxels: np.ndarray,
+               trace_id: Optional[str] = None) -> PendingRequest:
         """Enqueue one request; returns its future. Raises
         ``OverloadError`` immediately at the queue bound and
-        ``RuntimeError`` after ``drain()``."""
+        ``RuntimeError`` after ``drain()``. ``trace_id`` adopts a
+        caller-supplied trace id (the HTTP propagation header); None
+        mints one — either way the id rides the returned future."""
         p = PendingRequest(voxels)
         with self._cv:
             if self._draining:
                 raise RuntimeError(
                     "batcher is draining; no new requests accepted"
                 )
+            # Admit AFTER the draining check: a drain-race refusal must
+            # not count as an admitted trace (the /metrics invariant is
+            # admitted ≈ done + rejected). Cheap enough to hold the cv
+            # lock across: a counter bump, a clock read, 8 random bytes.
+            ctx = p.ctx = _tracing.admit(trace_id, self.trace_sample)
             depth = len(self._queue)
             if depth >= self.queue_limit:
                 self._rejected += 1
@@ -201,7 +252,11 @@ class ContinuousBatcher:
             # Emit outside the lock: the sink has its own, and a slow
             # filesystem must not extend the admission critical section.
             obs.emit("overload", queue_depth=depth, limit=self.queue_limit)
-            raise OverloadError(depth, self.queue_limit)
+            # Rejections are always sampled (tail bias): the structured
+            # trace is exactly what the operator chases after a 503.
+            _tracing.reject(ctx, depth, self.queue_limit)
+            raise OverloadError(depth, self.queue_limit,
+                                trace_id=ctx.trace_id)
         return p
 
     # -- dispatcher thread ---------------------------------------------------
@@ -249,9 +304,14 @@ class ContinuousBatcher:
     def _dispatch(self, batch: list[PendingRequest]) -> None:
         n = len(batch)
         bucket = pick_bucket(n, self.buckets)
+        # Single dispatcher thread: the sequence needs no lock, and
+        # every per-request dispatch record below names this batch.
+        self._batch_seq += 1
+        seq = self._batch_seq
         t_disp = time.perf_counter()
         for p in batch:
             _windows.observe("queue_wait_ms", (t_disp - p.t_enq) * 1e3)
+            _tracing.dispatch(p.ctx, seq, bucket, bucket - n)
         arr = np.stack([p.voxels for p in batch])
         if bucket > n:
             arr = np.concatenate(
@@ -260,7 +320,8 @@ class ContinuousBatcher:
         out = None
         err: Optional[BaseException] = None
         try:
-            with obs.span("serve_dispatch", bucket=bucket, n=n):
+            with obs.span("serve_dispatch", bucket=bucket, n=n,
+                          batch_seq=seq):
                 out = self.forward(bucket, arr)
         except Exception as e:  # resolve the batch; the batcher survives
             err = e
@@ -284,6 +345,16 @@ class ContinuousBatcher:
             # End-to-end latency = queue wait + dispatch + device +
             # readback: the number an SLO is written against.
             _windows.observe("serving_ms", (t_done - p.t_enq) * 1e3)
+            # De-mux fan-out: the trace completes with the per-request
+            # queue/device split (errors and SLO breaches force-sample).
+            _tracing.done(
+                p.ctx,
+                queue_wait_ms=(t_disp - p.t_enq) * 1e3,
+                dispatch_ms=(t_done - t_disp) * 1e3,
+                total_ms=(t_done - p.t_enq) * 1e3,
+                outcome="error" if err is not None else "ok",
+                slo_ms=self.trace_slo_ms,
+            )
         with self._cv:
             self._batches += 1
             self._rows += n
@@ -294,7 +365,7 @@ class ContinuousBatcher:
             else:
                 self._errors += n
         obs.emit("serve_batch", bucket=bucket, n=n, pad=bucket - n,
-                 dur_ms=round((t_done - t_disp) * 1e3, 3))
+                 batch_seq=seq, dur_ms=round((t_done - t_disp) * 1e3, 3))
 
     # -- lifecycle / introspection -------------------------------------------
     def stats(self) -> dict:

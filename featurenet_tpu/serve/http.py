@@ -12,8 +12,22 @@ Status codes carry the admission contract:
   load balancer can back off on structure, not on string-matching.
 - ``504`` — admitted but not answered within the handler timeout.
 
-``GET /stats`` (alias ``/healthz``) returns the batcher counters —
-served/rejected/occupancy/queue depth — for external monitoring.
+Trace propagation: a caller-supplied ``X-Featurenet-Trace`` request
+header is adopted as the request's trace id (``obs.tracing``) and echoed
+back on EVERY ``/predict`` response — 200s, overload 503s, even 400s —
+so a fleet router (or any upstream) can follow one request across the
+process hop. Without the header the server mints an id and the echo
+tells the caller what to grep for in the run log.
+
+``GET /stats`` returns the batcher counters — served/rejected/occupancy/
+queue depth. ``GET /healthz`` is the READINESS endpoint: ``{"ready":
+bool, "uptime_s": ..., "window_seq": ...}`` with HTTP 503 while not
+ready — false during warmup and from the moment drain begins, so a
+router's probe stops sending traffic before the queue empties (a
+warming or draining server must not answer "healthy"). ``GET /metrics``
+is the stdlib Prometheus-text exporter (``serve.metrics``): the same
+counters and rolling-window quantiles the SLO alerts fire on, scrape-
+able by the fleet router and external monitors.
 
 Threading model: ``ThreadingHTTPServer`` with daemon threads; each
 request thread does its own STL parse + voxelization (host-side geometry
@@ -27,9 +41,13 @@ from __future__ import annotations
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from featurenet_tpu.obs.tracing import TRACE_HEADER, normalize_trace_id
 from featurenet_tpu.serve.batcher import OverloadError
 
 DEFAULT_REQUEST_TIMEOUT_S = 60.0
+
+_ENDPOINTS = ["POST /predict", "GET /stats", "GET /healthz",
+              "GET /metrics"]
 
 
 def make_server(service, host: str = "127.0.0.1", port: int = 0,
@@ -44,36 +62,68 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
         def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
             pass  # access logging is the obs layer's job, not stderr's
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict,
+                  trace_id: str | None = None) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if trace_id:
+                # The propagation echo: whatever id this request ran
+                # under (supplied or minted) comes back on every
+                # outcome, so the caller can correlate and a router
+                # can follow the hop.
+                self.send_header(TRACE_HEADER, trace_id)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 (stdlib name)
-            if self.path in ("/stats", "/healthz"):
+            if self.path == "/stats":
                 self._json(200, {"ok": True, **service.stats()})
                 return
+            if self.path == "/healthz":
+                # Readiness split: 503 while warming or draining — the
+                # status code is what a router's probe keys off, the
+                # body says why.
+                health = service.health()
+                self._json(200 if health["ready"] else 503, health)
+                return
+            if self.path == "/metrics":
+                from featurenet_tpu.serve.metrics import (
+                    CONTENT_TYPE,
+                    render_metrics,
+                )
+
+                body = render_metrics(service).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             self._json(404, {"error": "not_found",
-                             "endpoints": ["POST /predict", "GET /stats"]})
+                             "endpoints": _ENDPOINTS})
 
         def do_POST(self):  # noqa: N802 (stdlib name)
             if self.path != "/predict":
                 self._json(404, {"error": "not_found",
-                                 "endpoints": ["POST /predict",
-                                               "GET /stats"]})
+                                 "endpoints": _ENDPOINTS})
                 return
+            # Adopt (or mint) the trace id BEFORE the parse: even a 400
+            # echoes the id the caller keyed its bookkeeping off.
+            trace_id = normalize_trace_id(
+                self.headers.get(TRACE_HEADER)
+            )
             length = int(self.headers.get("Content-Length") or 0)
             data = self.rfile.read(length)
             try:
-                fut = service.submit_stl_bytes(data)
+                fut = service.submit_stl_bytes(data, trace_id=trace_id)
             except OverloadError as e:
-                self._json(503, e.response)
+                self._json(503, e.response, trace_id=e.trace_id)
                 return
             except ValueError as e:
-                self._json(400, {"error": "bad_stl", "detail": str(e)})
+                self._json(400, {"error": "bad_stl", "detail": str(e)},
+                           trace_id=trace_id)
                 return
             except RuntimeError as e:
                 # A handler thread that slipped in between shutdown()
@@ -81,19 +131,22 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                 # answer it structurally like any other rejection, not
                 # with a dropped socket. (OverloadError is a
                 # RuntimeError; its clause above must come first.)
-                self._json(503, {"error": "draining", "detail": str(e)})
+                self._json(503, {"error": "draining", "detail": str(e)},
+                           trace_id=trace_id)
                 return
             try:
                 row = fut.result(timeout=request_timeout_s)
             except TimeoutError:
                 self._json(504, {"error": "timeout",
-                                 "timeout_s": request_timeout_s})
+                                 "timeout_s": request_timeout_s},
+                           trace_id=fut.trace_id)
                 return
             except RuntimeError as e:
                 self._json(500, {"error": "forward_failed",
-                                 "detail": str(e)})
+                                 "detail": str(e)}, trace_id=fut.trace_id)
                 return
-            self._json(200, service.format_row(row))
+            self._json(200, service.format_row(row),
+                       trace_id=fut.trace_id)
 
     srv = ThreadingHTTPServer((host, port), Handler)
     srv.daemon_threads = True

@@ -29,6 +29,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from featurenet_tpu import obs
+from featurenet_tpu.obs import tracing as _tracing
 from featurenet_tpu.obs.report import _pct
 from featurenet_tpu.serve.batcher import OverloadError
 
@@ -65,19 +67,38 @@ def poisson_load(service, qps: float, n_requests: int,
     arrivals = np.cumsum(rng.exponential(1.0 / qps, size=n_requests))
     t0 = time.perf_counter()
     futures: list = []
+    submit_t: list[float] = []  # per-future client submit stamp
     rejected = 0
     for i in range(n_requests):
         ahead = arrivals[i] - (time.perf_counter() - t0)
         if ahead > 0:
             time.sleep(ahead)
+        # The generator mints its own trace id per request (the client
+        # half of the propagation contract) and stamps the CLIENT clock
+        # before the submit call — client-observed latency covers
+        # validation + admission + queue + device on the same monotonic
+        # clock the server stamps with, so the client-vs-server skew is
+        # real queueing, never clock noise.
+        t_submit = time.perf_counter()
         try:
-            futures.append(service.submit_voxels(grids[i % len(grids)]))
+            futures.append(service.submit_voxels(
+                grids[i % len(grids)],
+                trace_id=_tracing.mint_trace_id(),
+            ))
+            submit_t.append(t_submit)
         except OverloadError:
             rejected += 1
     for fut in futures:
         fut.result(timeout=timeout_s)
     wall = time.perf_counter() - t0
     lats = sorted(f.latency_ms for f in futures)
+    # Client-observed latency per trace id: submit-call start → the
+    # dispatcher's resolution stamp (t_done), both perf_counter.
+    client_by_trace = {
+        f.trace_id: round((f.t_done - ts) * 1e3, 3)
+        for f, ts in zip(futures, submit_t)
+    }
+    client = sorted(client_by_trace.values())
     st = service.stats()
     stats = {
         "offered_qps": round(n_requests / float(arrivals[-1]), 1),
@@ -86,20 +107,29 @@ def poisson_load(service, qps: float, n_requests: int,
         "rejected": rejected,
         "p50_ms": round(_pct(lats, 50), 3) if lats else None,
         "p99_ms": round(_pct(lats, 99), 3) if lats else None,
+        "client_p50_ms": round(_pct(client, 50), 3) if client else None,
+        "client_p99_ms": round(_pct(client, 99), 3) if client else None,
+        "client_by_trace": client_by_trace,
         "occupancy": st["occupancy"],
         "by_bucket": st["by_bucket"],
     }
+    if client:
+        # The client-side summary lands in the run log so the report's
+        # traces section can state the client-vs-server p99 skew next
+        # to the sampled server timelines (no-op when dark).
+        obs.emit("loadgen", n=len(client),
+                 client_p50_ms=stats["client_p50_ms"],
+                 client_p99_ms=stats["client_p99_ms"],
+                 offered_qps=stats["offered_qps"])
     return stats, futures
 
 
-def bench_serving(cfg, qps: float, n_requests: int = 512,
-                  buckets: Sequence[int] = (1, 4, 16, 64),
-                  max_wait_ms: float = 5.0,
-                  queue_limit: int = 256) -> dict:
-    """The bench.py serving row: build a random-init service for ``cfg``
-    (throughput is weight-agnostic, like ``measure_inference``), run the
-    open-loop generator at ``qps``, drain, and return flat ``serve_*``
-    fields for the gate summary."""
+def _build_service(cfg, buckets: Sequence[int], max_wait_ms: float,
+                   queue_limit: int, **service_kw):
+    """One random-init service builder for every loadgen probe
+    (throughput is weight-agnostic, like ``measure_inference``) — the
+    construction boilerplate must not fork between the open-loop row
+    and the trace-overhead probe."""
     import jax
     import jax.numpy as jnp
 
@@ -116,10 +146,20 @@ def bench_serving(cfg, qps: float, n_requests: int = 512,
         variables["params"], variables["batch_stats"], cfg,
         batch=max(buckets),
     )
-    service = InferenceService(
+    return InferenceService(
         pred, buckets=buckets, max_wait_ms=max_wait_ms,
-        queue_limit=queue_limit,
+        queue_limit=queue_limit, **service_kw,
     )
+
+
+def bench_serving(cfg, qps: float, n_requests: int = 512,
+                  buckets: Sequence[int] = (1, 4, 16, 64),
+                  max_wait_ms: float = 5.0,
+                  queue_limit: int = 256) -> dict:
+    """The bench.py serving row: build a random-init service for ``cfg``,
+    run the open-loop generator at ``qps``, drain, and return flat
+    ``serve_*`` fields for the gate summary."""
+    service = _build_service(cfg, buckets, max_wait_ms, queue_limit)
     try:
         stats, _ = poisson_load(
             service, qps=qps, n_requests=n_requests,
@@ -132,8 +172,80 @@ def bench_serving(cfg, qps: float, n_requests: int = 512,
         "serve_qps_sustained": stats["sustained_qps"],
         "serve_p50_ms": stats["p50_ms"],
         "serve_p99_ms": stats["p99_ms"],
+        # The client-observed percentiles beside the server windows:
+        # the gap between serve_client_p99_ms and serve_p99_ms is
+        # queueing upstream of admission, on one clock.
+        "serve_client_p50_ms": stats["client_p50_ms"],
+        "serve_client_p99_ms": stats["client_p99_ms"],
         "serve_occupancy": stats["occupancy"],
         "serve_rejected": stats["rejected"],
         "serve_buckets": {str(k): v for k, v in stats["by_bucket"].items()},
         "serve_requests": n_requests,
+    }
+
+
+def measure_trace_overhead(cfg, n_requests: int = 192,
+                           buckets: Sequence[int] = (1, 4, 16),
+                           run_dir: Optional[str] = None) -> dict:
+    """The tracing tax, measured: closed-loop request rate through one
+    warmed service with the sampler OFF (``trace_sample=0`` — contexts
+    still mint, nothing flushes) vs fully ON (``trace_sample=1`` —
+    every request's admit/dispatch/done lands in the stream), same
+    session so the service/executables are identical. The returned
+    ``trace_overhead_pct`` is pinned (max) in the bench gate: tracing
+    can never silently grow a hot-path cost. Both phases run with the
+    sink active, so the delta isolates the TRACING emission cost rather
+    than file-I/O-in-general. ``run_dir`` None uses a throwaway dir."""
+    import shutil
+    import tempfile
+
+    if obs.active():
+        # The probe owns the process-wide obs state (it installs and
+        # then CLOSES its own run); silently tearing down the caller's
+        # live run — leaving every later emit dark — is worse than a
+        # refusal naming the precondition.
+        raise RuntimeError(
+            "measure_trace_overhead installs and closes its own obs "
+            "run; close_run() the active run first"
+        )
+    tmp = run_dir or tempfile.mkdtemp(prefix="trace_overhead_")
+    obs.init_run(tmp, extra={"cmd": "trace_overhead"}, process_index=0)
+    # slo_p99_ms=inf: the closed-loop burst queues requests for far
+    # longer than any real SLO, and a finite threshold would FORCE-
+    # sample the tail even in the "dark" phase — both phases would then
+    # do the same emission work and the probe would measure ~0 overhead
+    # no matter what tracing costs. rules=() for the same reason: this
+    # probe measures the tracing delta, not the alert engine.
+    service = _build_service(
+        cfg, buckets, max_wait_ms=2.0,
+        queue_limit=max(256, n_requests), rules=(),
+        slo_p99_ms=float("inf"),
+    )
+    grid = np.zeros((cfg.resolution,) * 3 + (1,), np.float32)
+
+    def closed_loop_qps() -> float:
+        t0 = time.perf_counter()
+        futs = [service.submit_voxels(grid) for _ in range(n_requests)]
+        for f in futs:
+            f.result(timeout=120.0)
+        return n_requests / (time.perf_counter() - t0)
+
+    try:
+        service.batcher.trace_sample = 0.0   # dark sampler, warm pass
+        closed_loop_qps()                    # JIT/page-cache warmup
+        dark = closed_loop_qps()
+        service.batcher.trace_sample = 1.0   # every request sampled
+        traced = closed_loop_qps()
+    finally:
+        service.drain()
+        obs.close_run()
+        if run_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "trace_overhead_pct": round(
+            max(0.0, (dark - traced) / dark * 100.0), 2
+        ) if dark > 0 else None,
+        "trace_dark_qps": round(dark, 1),
+        "trace_sampled_qps": round(traced, 1),
+        "trace_overhead_requests": n_requests,
     }

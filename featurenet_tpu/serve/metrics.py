@@ -1,0 +1,120 @@
+"""Stdlib-only Prometheus text exporter for the serving path.
+
+``GET /metrics`` folds the numbers the process already keeps — the
+batcher's counters, the event sink's per-kind counts (compiles, cache
+verdicts, overloads), the tracing sampler's totals, and the rolling
+``RollingWindow`` summaries — into the Prometheus text exposition
+format (version 0.0.4), so the fleet router and any external monitor
+scrape the SAME windows the SLO alerts fire on. No client library, no
+histogram buckets: quantile-style gauges (``featurenet_serving_ms
+{q="0.99"}``) mirror the nearest-rank percentiles the ``window_summary``
+events carry, which is what makes the exporter's numbers bit-equal to
+the report's.
+
+The name set is a closed registry (``METRIC_NAMES``): every line the
+exporter can emit is declared here and the window gauge family is
+derived from ``alerts.WINDOW_METRICS``, so a renamed window metric
+changes the exporter with it — never a silently dropped scrape series.
+A drift test pins exporter output ⊆ registry.
+"""
+
+from __future__ import annotations
+
+from featurenet_tpu.obs import events as _events
+from featurenet_tpu.obs import tracing as _tracing
+from featurenet_tpu.obs import windows as _windows
+from featurenet_tpu.obs.alerts import WINDOW_METRICS
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_PREFIX = "featurenet_"
+
+# Event kinds worth exporting as counters, with their metric names: the
+# sink counts every emit per kind, so these are free and always agree
+# with what `cli report` will later count from the stream.
+_EVENT_COUNTERS = {
+    "program_compile": "program_compiles_total",
+    "cache_hit": "exec_cache_hits_total",
+    "cache_miss": "exec_cache_misses_total",
+    "cache_reject": "exec_cache_rejects_total",
+    "overload": "overloads_total",
+    "serve_batch": "serve_batches_total",
+}
+
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+# Every metric family this exporter can emit (base names, no labels).
+METRIC_NAMES = frozenset(
+    {
+        "ready",
+        "uptime_seconds",
+        "window_seq",
+        "requests_total",          # labeled by outcome: served/rejected/error
+        "serve_queue_depth",
+        "serve_occupancy",
+        "trace_admitted_total",
+        "trace_sampled_total",
+        "trace_forced_total",
+    }
+    | set(_EVENT_COUNTERS.values())
+    # One gauge family per rolling window (quantile-labeled) + its count.
+    | set(WINDOW_METRICS)
+    | {f"{m}_count" for m in WINDOW_METRICS}
+)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return format(float(v), "g")
+
+
+def render_metrics(service) -> str:
+    """The /metrics body for one ``InferenceService``: counters first,
+    then the rolling-window quantile gauges. Honest absence throughout —
+    a window with no samples emits nothing, a dark sink contributes no
+    event counters (the batcher/tracing numbers still export)."""
+    lines: list[str] = []
+
+    def row(name: str, value, labels: str = "",
+            kind: str | None = None) -> None:
+        full = _PREFIX + name
+        if kind is not None:
+            lines.append(f"# TYPE {full} {kind}")
+        lines.append(f"{full}{labels} {_fmt(value)}")
+
+    health = service.health()
+    row("ready", health["ready"], kind="gauge")
+    row("uptime_seconds", health["uptime_s"], kind="gauge")
+    if health.get("window_seq") is not None:
+        row("window_seq", health["window_seq"], kind="gauge")
+
+    st = service.stats()
+    row("requests_total", st["served"], '{outcome="served"}',
+        kind="counter")
+    row("requests_total", st["rejected"], '{outcome="rejected"}')
+    row("requests_total", st["errors"], '{outcome="error"}')
+    row("serve_queue_depth", st["queue_depth"], kind="gauge")
+    if st.get("occupancy") is not None:
+        row("serve_occupancy", st["occupancy"], kind="gauge")
+
+    kinds = _events.kind_counts()
+    for ev, name in sorted(_EVENT_COUNTERS.items()):
+        if ev in kinds:
+            row(name, kinds[ev], kind="counter")
+
+    tc = _tracing.counters()
+    row("trace_admitted_total", tc["admitted"], kind="counter")
+    row("trace_sampled_total", tc["sampled"], kind="counter")
+    row("trace_forced_total", tc["forced"], kind="counter")
+
+    for metric, summary in sorted(_windows.snapshot().items()):
+        full = _PREFIX + metric
+        lines.append(f"# TYPE {full} gauge")
+        for q, stat in _QUANTILES:
+            lines.append(f'{full}{{q="{q}"}} {_fmt(summary[stat])}')
+        lines.append(f"{_PREFIX}{metric}_count {summary['n']}")
+
+    return "\n".join(lines) + "\n"

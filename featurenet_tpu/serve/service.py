@@ -31,6 +31,7 @@ so CI can gate on latency regressions the same way it gates on accuracy.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -87,6 +88,12 @@ class InferenceService:
         self.predictor = predictor
         self.cfg = predictor.cfg
         self.buckets = normalize_buckets(buckets)
+        # Readiness (the /healthz split): a server is ready only between
+        # warmup completing and drain beginning — today a warming or
+        # draining process would answer "healthy" to a router probing
+        # it, which is exactly when it must not receive traffic.
+        self._t_start = time.perf_counter()
+        self._ready = False
         # AOT warmup: one serve build per bucket through the runtime
         # registry (memoized in Predictor._programs, which _forward
         # re-resolves per dispatch). This loop is the whole reason no
@@ -109,9 +116,16 @@ class InferenceService:
             self._forward, buckets=self.buckets, max_wait_ms=max_wait_ms,
             queue_limit=queue_limit,
             cost_for=costs.get, peaks=_perf.local_device_peaks(),
+            # Request tracing (obs.tracing): the config's healthy-traffic
+            # sampling rate; a request breaching the serving SLO is
+            # always sampled regardless (the p99 EXEMPLARS matter as
+            # much as the p99).
+            trace_sample=getattr(self.cfg, "trace_sample", 1.0),
+            trace_slo_ms=float(slo_p99_ms),
         )
         obs.emit("serve_start", buckets=list(self.buckets),
                  max_wait_ms=float(max_wait_ms), queue_limit=int(queue_limit))
+        self._ready = True
 
     # -- the dispatch hot path ----------------------------------------------
     def _forward(self, bucket: int, padded: np.ndarray):
@@ -119,9 +133,12 @@ class InferenceService:
         return np.asarray(self.predictor.forward_padded(padded, batch=bucket))
 
     # -- request entry points ------------------------------------------------
-    def submit_voxels(self, grid: np.ndarray) -> PendingRequest:
+    def submit_voxels(self, grid: np.ndarray,
+                      trace_id: Optional[str] = None) -> PendingRequest:
         """Enqueue one ``[R,R,R]`` (or ``[R,R,R,1]``) occupancy grid;
-        returns its future. ``OverloadError`` at the admission bound."""
+        returns its future. ``OverloadError`` at the admission bound.
+        ``trace_id`` adopts a caller-supplied trace id (propagation);
+        None mints one at admission."""
         # lint: allow-host-sync(host-side request payload, never on device)
         g = np.asarray(grid, dtype=np.float32)
         if g.ndim == 3:
@@ -131,10 +148,10 @@ class InferenceService:
             raise ValueError(
                 f"expected one [{R},{R},{R}(,1)] grid, got {g.shape}"
             )
-        return self.batcher.submit(g)
+        return self.batcher.submit(g, trace_id=trace_id)
 
-    def submit_stl_bytes(self, data: bytes,
-                         fill: bool = True) -> PendingRequest:
+    def submit_stl_bytes(self, data: bytes, fill: bool = True,
+                         trace_id: Optional[str] = None) -> PendingRequest:
         """The upload path: raw STL bytes → parse → normalize+voxelize →
         enqueue. Geometry runs in the caller's thread (an HTTP worker),
         never the dispatch thread; malformed bytes raise ``ValueError``
@@ -145,7 +162,8 @@ class InferenceService:
         tris = parse_stl(data)
         grid = voxelize(tris, self.cfg.resolution, fill=fill)
         # lint: allow-precision(wire contract: the serve input edge is fp32)
-        return self.submit_voxels(grid.astype(np.float32))
+        return self.submit_voxels(grid.astype(np.float32),
+                                  trace_id=trace_id)
 
     def format_row(self, row: np.ndarray) -> dict:
         """One request's output row as the wire response: class + top-3
@@ -186,6 +204,22 @@ class InferenceService:
     def stats(self) -> dict:
         return self.batcher.stats()
 
+    def ready(self) -> bool:
+        """True only between warmup completing and drain beginning —
+        the /healthz readiness verdict a fleet router keys traffic off."""
+        return self._ready
+
+    def health(self) -> dict:
+        """The /healthz payload: the readiness split plus uptime and
+        the last rolling-window emission seq (a monitor can tell a
+        fresh server from one whose windows have moved)."""
+        return {
+            "ready": self.ready(),
+            "uptime_s": round(time.perf_counter() - self._t_start, 3),
+            "window_seq": _windows.last_seq(),
+            "queue_depth": self.batcher.stats()["queue_depth"],
+        }
+
     def drain(self, timeout_s: float = 30.0) -> dict:
         """Stop accepting, answer everything admitted, flush the final
         window cycle, and report the SLO verdict: ``exit_code`` is 2 when
@@ -193,6 +227,9 @@ class InferenceService:
         unresolved at drain time — the CI latency gate — or when the
         batcher's drain timed out with admitted requests unanswered;
         else 0."""
+        # Readiness drops the moment drain BEGINS: a router probing
+        # /healthz must stop routing here before the queue empties.
+        self._ready = False
         st = self.batcher.drain(timeout_s)
         _windows.flush()
         active = [

@@ -1,0 +1,598 @@
+"""Request-level distributed tracing (featurenet_tpu.obs.tracing) + the
+serving /metrics exporter and /healthz readiness split.
+
+The acceptance spine (ISSUE 13): a request submitted with a caller trace
+id gets it echoed in the HTTP response; `cli report --request <id>`
+renders the full admit→dispatch→done timeline with batch attribution;
+`GET /metrics` parses as Prometheus text and its serving_ms quantiles
+match the report's window summary; sampling is deterministic across
+processes and tail-biased (rejections / errors / SLO breaches are
+always kept); and the loadgen's client-observed p99 dominates the
+server-side p99 (the skew is real queueing on one clock). The tracing
+e2e's run dir is schema-linted through `cli report --validate` —
+tier-1's wiring for the new event kinds.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from featurenet_tpu import obs
+from featurenet_tpu.config import get_config
+from featurenet_tpu.obs import tracing, windows
+from featurenet_tpu.obs.report import (
+    build_report,
+    format_report,
+    format_request_timeline,
+    load_events,
+    request_timeline,
+    validate_events,
+)
+from featurenet_tpu.serve.batcher import ContinuousBatcher, OverloadError
+from featurenet_tpu.serve.loadgen import poisson_load
+from featurenet_tpu.serve.service import InferenceService
+
+RES = 16  # smoke16 resolution — every real-model test runs at 16³
+
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.eE+-]+|NaN)$"
+)
+
+
+def _grid(value: float = 1.0) -> np.ndarray:
+    return np.full((RES, RES, RES, 1), value, np.float32)
+
+
+def _sum_forward():
+    def forward(bucket, arr):
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    return forward
+
+
+def _parse_prom(text: str) -> dict:
+    """{(name, labels): float} for every sample line; asserts the whole
+    body is well-formed exposition text."""
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"unparseable Prometheus line: {line!r}"
+        out[(m.group(1), m.group(2) or "")] = float(m.group(3))
+    return out
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    """Random-init smoke16 Predictor (weights don't matter for tracing
+    and exporter semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.registry import build_model
+
+    cfg = get_config("smoke16", data_workers=1)
+    variables = build_model(cfg).init(
+        jax.random.key(0), jnp.zeros((1, RES, RES, RES, 1), jnp.float32),
+        train=False,
+    )
+    return Predictor(
+        variables["params"], variables["batch_stats"], cfg, batch=4
+    )
+
+
+@pytest.fixture()
+def stl_bytes(tmp_path):
+    from featurenet_tpu.data.mesh_primitives import mesh_box
+    from featurenet_tpu.data.stl import save_stl
+
+    p = str(tmp_path / "part.stl")
+    save_stl(p, mesh_box((0.2, 0.2, 0.2), (0.8, 0.8, 0.7)))
+    with open(p, "rb") as fh:
+        return fh.read()
+
+
+# --- ids + sampling (unit) ---------------------------------------------------
+
+def test_trace_id_mint_normalize_and_config_guard():
+    a, b = tracing.mint_trace_id(), tracing.mint_trace_id()
+    assert a != b and re.fullmatch(r"[0-9a-f]{16}", a)
+    # Well-formed supplied ids are adopted; garbage is replaced.
+    assert tracing.normalize_trace_id("router-7.42_a") == "router-7.42_a"
+    for bad in (None, "", "a b", "x" * 65, "péché", "a\njson-inject"):
+        got = tracing.normalize_trace_id(bad)
+        assert got != bad and re.fullmatch(r"[0-9a-f]{16}", got)
+    with pytest.raises(ValueError, match="trace_sample"):
+        get_config("smoke16", trace_sample=1.5)
+    with pytest.raises(ValueError, match="trace_sample"):
+        ContinuousBatcher(_sum_forward(), buckets=(1,), trace_sample=-0.1)
+
+
+def test_sampling_deterministic_across_processes():
+    """The rate decision is a pure hash of the trace id: a second
+    process (the future fleet router, another serving host) reaches the
+    same verdicts with no coordination."""
+    ids = [tracing.mint_trace_id() for _ in range(64)]
+    here = [tracing.sampled(i, 0.5) for i in ids]
+    # Rate 0.5 over 64 ids: both outcomes must actually occur, or the
+    # determinism check below would be vacuous.
+    assert any(here) and not all(here)
+    src = (
+        "import json,sys\n"
+        "from featurenet_tpu.obs.tracing import sampled\n"
+        "ids=json.loads(sys.argv[1])\n"
+        "print(json.dumps([sampled(i,0.5) for i in ids]))\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", src, json.dumps(ids)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1]) == here
+    # Boundary rates short-circuit.
+    assert tracing.sampled(ids[0], 1.0) and not tracing.sampled(ids[0], 0.0)
+
+
+# --- tail-biased sampling through the batcher --------------------------------
+
+def test_rate_zero_drops_healthy_but_always_samples_reject_and_error(
+    tmp_path
+):
+    """trace_sample=0: a healthy request leaves NO request_* events; a
+    rejection and a forward error are always sampled (tail bias)."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    gate = threading.Event()
+    flaky = {"fail": False}
+
+    def forward(bucket, arr):
+        gate.wait(30)
+        if flaky["fail"]:
+            flaky["fail"] = False
+            raise ValueError("injected forward failure")
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b = ContinuousBatcher(forward, buckets=(1, 2), max_wait_ms=1,
+                          queue_limit=2, trace_sample=0.0,
+                          trace_slo_ms=10_000.0)
+    gate.set()
+    b.submit(np.ones((1,))).result(30)  # healthy: dropped by rate 0
+    gate.clear()
+    first = b.submit(np.ones((1,)))  # occupies the dispatcher
+    time.sleep(0.2)
+    fill = [b.submit(np.ones((1,))) for _ in range(2)]
+    with pytest.raises(OverloadError) as ei:
+        b.submit(np.ones((1,)))
+    assert ei.value.trace_id  # the reject carries its id
+    gate.set()
+    for f in [first] + fill:
+        f.result(30)
+    gate.clear()
+    flaky["fail"] = True
+    gate.set()
+    bad = b.submit(np.ones((1,)))
+    with pytest.raises(RuntimeError, match="injected forward failure"):
+        bad.result(30)
+    b.drain()
+    obs.close_run()
+    events, _ = load_events(run_dir)
+    done = [e for e in events if e["ev"] == "request_done"]
+    rejects = [e for e in events if e["ev"] == "request_reject"]
+    # Exactly the error completed a sampled timeline; the 4 healthy
+    # requests were dropped by the rate.
+    assert [e["outcome"] for e in done] == ["error"]
+    assert done[0]["forced"] is True
+    assert len(rejects) == 1
+    assert rejects[0]["trace"] == ei.value.trace_id
+    assert rejects[0]["queue_depth"] == 2 and rejects[0]["limit"] == 2
+    # Every sampled timeline is complete: its admit (and, for the error,
+    # dispatch) flushed with it despite the late decision.
+    admits = {e["trace"] for e in events if e["ev"] == "request_admit"}
+    assert admits == {done[0]["trace"], rejects[0]["trace"]}
+    assert [e["trace"] for e in events
+            if e["ev"] == "request_dispatch"] == [done[0]["trace"]]
+
+
+def test_slo_breach_always_sampled_at_rate_zero(tmp_path):
+    """A request breaching trace_slo_ms is kept at any rate — the p99
+    exemplars are the point of tracing."""
+    obs_dir = str(tmp_path / "run")
+    obs.init_run(obs_dir, process_index=0)
+
+    def slow(bucket, arr):
+        time.sleep(0.05)
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b = ContinuousBatcher(slow, buckets=(1,), max_wait_ms=1,
+                          queue_limit=4, trace_sample=0.0,
+                          trace_slo_ms=1.0)
+    b.submit(np.ones((1,))).result(30)
+    b.drain()
+    obs.close_run()
+    events, _ = load_events(obs_dir)
+    done = [e for e in events if e["ev"] == "request_done"]
+    assert len(done) == 1 and done[0]["forced"] is True
+    assert done[0]["outcome"] == "ok" and done[0]["total_ms"] > 1.0
+
+
+def test_batch_seq_ties_requests_to_their_dispatch(tmp_path):
+    """One dispatch fans in N trace ids: every request_dispatch of a
+    batch carries the same batch_seq as its serve_batch event and
+    serve_dispatch span."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    gate = threading.Event()
+
+    def gated(bucket, arr):
+        gate.wait(30)
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b = ContinuousBatcher(gated, buckets=(1, 4), max_wait_ms=5,
+                          queue_limit=16)
+    first = b.submit(np.ones((1,)))
+    time.sleep(0.15)  # dispatcher picks it up and blocks
+    burst = [b.submit(np.ones((1,))) for _ in range(4)]
+    gate.set()
+    for f in [first] + burst:
+        f.result(30)
+    b.drain()
+    obs.close_run()
+    events, _ = load_events(run_dir)
+    disp = [e for e in events if e["ev"] == "request_dispatch"]
+    sb = {e["batch_seq"]: e for e in events if e["ev"] == "serve_batch"}
+    spans = {e.get("batch_seq"): e for e in events
+             if e["ev"] == "span" and e.get("name") == "serve_dispatch"}
+    assert len(disp) == 5 and len(sb) == 2
+    by_seq: dict[int, list] = {}
+    for e in disp:
+        by_seq.setdefault(e["batch_seq"], []).append(e)
+    # The 4-burst rode ONE dispatch; its pad/bucket agree everywhere.
+    sizes = sorted(len(v) for v in by_seq.values())
+    assert sizes == [1, 4]
+    for seq, evs in by_seq.items():
+        assert seq in sb and seq in spans
+        assert {e["bucket"] for e in evs} == {sb[seq]["bucket"]}
+        assert {e["pad"] for e in evs} == {sb[seq]["pad"]}
+    # Old logs without batch_seq keep validating (legacy-optional).
+    legacy = [{"t": 1.0, "ev": "serve_batch", "bucket": 4, "n": 2}]
+    assert validate_events(legacy) == []
+
+
+# --- HTTP: header roundtrip, /healthz readiness, /metrics --------------------
+
+def test_http_trace_header_roundtrip_healthz_and_metrics(
+    tmp_path, predictor, stl_bytes
+):
+    import http.client
+
+    from featurenet_tpu.serve.http import make_server
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    service = InferenceService(
+        predictor, buckets=(1, 4), max_wait_ms=2, queue_limit=8,
+        rules=(),
+    )
+    assert service.ready() is True
+    srv = make_server(service, port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+
+    def request(method, path, body=None, headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        raw = resp.read().decode()
+        echo = resp.getheader("X-Featurenet-Trace")
+        conn.close()
+        return resp.status, raw, echo
+
+    try:
+        # Supplied id echoed on 200 and present in the events.
+        status, body, echo = request(
+            "POST", "/predict", stl_bytes,
+            {"X-Featurenet-Trace": "caller-42"},
+        )
+        assert status == 200 and echo == "caller-42"
+        # No header → the server mints and still echoes.
+        status, _, echo2 = request("POST", "/predict", stl_bytes)
+        assert status == 200 and re.fullmatch(r"[0-9a-f]{16}", echo2)
+        # A malformed body still echoes the (sanitized) id on the 400.
+        status, err, echo3 = request(
+            "POST", "/predict", b"not an stl",
+            {"X-Featurenet-Trace": "caller-43"},
+        )
+        assert status == 400 and echo3 == "caller-43"
+        assert json.loads(err)["error"] == "bad_stl"
+
+        # /healthz: ready while serving…
+        status, body, _ = request("GET", "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["ready"] is True
+        assert health["uptime_s"] > 0
+        # …503 while warming (simulated via the same flag construction
+        # clears) and from the moment drain begins.
+        service._ready = False
+        status, body, _ = request("GET", "/healthz")
+        assert status == 503 and json.loads(body)["ready"] is False
+        service._ready = True
+
+        # /metrics parses as Prometheus text, its names stay inside the
+        # registry, and the serving_ms quantiles match the report's
+        # window summary exactly (same windows, same formula).
+        windows.flush()
+        status, text, _ = request("GET", "/metrics")
+        assert status == 200
+        samples = _parse_prom(text)
+        from featurenet_tpu.serve.metrics import METRIC_NAMES
+
+        for (name, _labels) in samples:
+            assert name.startswith("featurenet_")
+            base = name[len("featurenet_"):]
+            assert base in METRIC_NAMES, base
+        assert samples[("featurenet_ready", "")] == 1.0
+        assert samples[("featurenet_requests_total",
+                        '{outcome="served"}')] >= 2
+        assert samples[("featurenet_trace_sampled_total", "")] >= 2
+        # The ladder warmed through the registry while the sink was up
+        # (bucket 4 is memoized on the shared predictor fixture; bucket
+        # 1 compiles under THIS sink and lands in the counter).
+        assert samples[("featurenet_program_compiles_total", "")] >= 1
+    finally:
+        srv.shutdown()
+        st = service.drain()
+    assert service.ready() is False
+    obs.close_run()
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    done = {e["trace"]: e for e in events if e["ev"] == "request_done"}
+    assert "caller-42" in done and echo2 in done
+    # The scraped serving_ms quantiles equal the LAST window_summary the
+    # report folds (drain's flush emits nothing new: no samples landed
+    # after the pre-scrape flush).
+    rep = build_report(events)
+    win = rep["slo"]["windows"]["serving_ms"]
+    assert samples[("featurenet_serving_ms", '{q="0.99"}')] == win["p99"]
+    assert samples[("featurenet_serving_ms", '{q="0.5"}')] == win["p50"]
+    assert samples[("featurenet_serving_ms_count", "")] == win["n"]
+    assert st["exit_code"] == 0
+
+
+# --- the acceptance e2e: loadgen + report --request + --validate -------------
+
+def test_loadgen_trace_e2e_report_request_and_validate(
+    tmp_path, predictor, capsys
+):
+    from featurenet_tpu.cli import main as cli_main
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    service = InferenceService(
+        predictor, buckets=(1, 4, 16), max_wait_ms=10, queue_limit=64,
+        rules=(),
+    )
+    grids = np.stack([_grid(float(i % 3)) for i in range(8)])
+    stats, futs = poisson_load(
+        service, qps=200.0, n_requests=24,
+        rng=np.random.default_rng(3), grids=grids,
+    )
+    service.drain()
+    obs.close_run()
+
+    # Client-observed latency per trace id, p50/p99 beside the server
+    # windows — and the client p99 DOMINATES the server p99: the same
+    # monotonic clock stamps both ends, so the skew is real queueing.
+    assert stats["accepted"] == 24
+    assert len(stats["client_by_trace"]) == 24
+    assert stats["client_p99_ms"] >= stats["p99_ms"]
+    for f in futs:
+        assert stats["client_by_trace"][f.trace_id] >= f.latency_ms - 1e-6
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    # Every accepted request's timeline is in the stream (rate 1.0).
+    done = [e for e in events if e["ev"] == "request_done"]
+    assert len(done) == 24
+    # The loadgen's client summary landed and the report states the skew.
+    rep = build_report(events)
+    tr = rep["traces"]
+    assert tr["sampled"] == 24
+    assert len(tr["slowest"]) == 10
+    assert all(row["batch_seq"] is not None for row in tr["slowest"])
+    assert tr["client"]["n"] == 24
+    assert tr["client"]["skew_p99_ms"] is not None
+    text = format_report(rep)
+    assert "traces: 24 sampled request(s)" in text
+    assert "client (loadgen):" in text
+
+    # `cli report --request <id>`: the full admit→dispatch→done timeline
+    # with batch attribution, straight off the run dir.
+    tid = futs[0].trace_id
+    tl = request_timeline(events, tid)
+    assert tl["found"]
+    assert [e["event"] for e in tl["events"]] == [
+        "request_admit", "request_dispatch", "request_done",
+    ]
+    disp = tl["events"][1]
+    assert disp["batch_seq"] >= 1 and disp["bucket"] in (1, 4, 16)
+    rendered = format_request_timeline(tl)
+    assert tid in rendered and "request_dispatch" in rendered
+    cli_main(["report", run_dir, "--request", tid])
+    out = capsys.readouterr().out
+    assert tid in out and "request_done" in out
+    with pytest.raises(SystemExit) as ei:
+        cli_main(["report", run_dir, "--request", "no-such-trace"])
+    assert ei.value.code == 2
+    assert "sampling" in capsys.readouterr().out
+
+    # Tier-1 wiring: the new kinds schema-lint clean against a REAL log.
+    cli_main(["report", run_dir, "--validate"])
+    assert '"validate": "ok"' in capsys.readouterr().out
+
+    # The Chrome trace links the requests as async flow events.
+    from featurenet_tpu.obs.spans import chrome_trace
+
+    ct = chrome_trace(events)
+    reqs = [e for e in ct["traceEvents"] if e.get("cat") == "request"]
+    assert {"b", "e", "s", "f"} <= {e["ph"] for e in reqs}
+    assert any(e.get("id") == tid for e in reqs)
+
+
+def test_traces_section_suppresses_skew_on_biased_sample():
+    """Below rate 1.0 the sampled request_done set is tail-biased by
+    design — its percentiles are labeled biased and the client-vs-server
+    skew is suppressed rather than reported against them."""
+    evs = [
+        {"t": 1.0, "ev": "request_done", "trace": "a", "queue_wait_ms": 1,
+         "dispatch_ms": 400, "total_ms": 401.0, "outcome": "ok",
+         "forced": True},
+        {"t": 2.0, "ev": "loadgen", "n": 100, "client_p50_ms": 3.0,
+         "client_p99_ms": 12.0},
+    ]
+    manifest = {"config": {"trace_sample": 0.1}}
+    tr = build_report(evs, manifest)["traces"]
+    assert tr["sample_biased"] is True and tr["sample_rate"] == 0.1
+    assert "skew_p99_ms" not in tr["client"]
+    assert "tail-biased sample" in format_report(
+        build_report(evs, manifest)
+    )
+    # At rate 1.0 (or no manifest) the set is complete: skew reported.
+    tr_full = build_report(evs)["traces"]
+    assert tr_full["client"]["skew_p99_ms"] == pytest.approx(-389.0)
+    assert "sample_biased" not in tr_full
+
+
+# --- trace overhead measurement (the bench pin's source) ---------------------
+
+def test_measure_trace_overhead_shape(tmp_path):
+    from featurenet_tpu.serve.loadgen import measure_trace_overhead
+
+    cfg = get_config("smoke16", data_workers=1)
+    # The probe owns the process obs state: a caller with a live run
+    # gets a refusal, never a silently-torn-down sink.
+    obs.init_run(str(tmp_path / "live"), process_index=0)
+    with pytest.raises(RuntimeError, match="close_run"):
+        measure_trace_overhead(cfg, n_requests=8, buckets=(1,))
+    obs.close_run()
+    row = measure_trace_overhead(cfg, n_requests=32, buckets=(1, 4))
+    assert row["trace_dark_qps"] > 0 and row["trace_sampled_qps"] > 0
+    assert row["trace_overhead_pct"] is not None
+    assert row["trace_overhead_pct"] >= 0.0
+    assert row["trace_overhead_requests"] == 32
+
+
+def test_init_run_switch_resets_tracing_counters(tmp_path):
+    """Run B's /metrics must not report run A's sampled totals: both
+    the close_run path and the init_run run-SWITCH path zero the
+    tracing counters alongside the fresh sink's per-kind counts."""
+    obs.init_run(str(tmp_path / "a"), process_index=0)
+    ctx = tracing.admit(None, 1.0)
+    tracing.done(ctx, 1.0, 1.0, 2.0, "ok")
+    assert tracing.counters()["admitted"] == 1
+    obs.init_run(str(tmp_path / "b"), process_index=0)
+    assert tracing.counters() == {
+        "admitted": 0, "done": 0, "sampled": 0, "forced": 0,
+        "rejected": 0,
+    }
+    obs.close_run()
+
+
+def test_bench_gate_trace_and_client_keys():
+    from featurenet_tpu.obs import gates
+
+    summary = {
+        "trace_overhead_pct": 1.4,
+        "serve_client_p99_ms": 12.0,
+        "serve_p99_ms": 9.0,
+    }
+    vals = gates.bench_gate_values(summary)
+    assert set(summary) <= set(vals)
+    pin = gates.make_baseline(vals)["gates"]
+    assert pin["trace_overhead_pct"]["direction"] == "max"
+    assert pin["serve_client_p99_ms"]["direction"] == "max"
+    worse = dict(vals, trace_overhead_pct=25.0)
+    res = gates.evaluate_gates(worse, {"gates": pin})
+    assert not res["ok"] and "trace_overhead_pct" in res["failed"]
+
+
+# --- bench-history -----------------------------------------------------------
+
+def test_bench_history_table_and_skipped_reasons(tmp_path, capsys):
+    from featurenet_tpu.cli import main as cli_main
+    from featurenet_tpu.obs.bench_history import (
+        format_history,
+        load_rounds,
+    )
+
+    d = str(tmp_path)
+    # r1: driver-wrapped healthy round; r2: structured skip; r3: the
+    # pre-hardening outage shape (parsed null); r4: bare (unwrapped)
+    # record with a gate verdict.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "rc": 0,
+        "parsed": {"metric": "m", "value": 2372.3, "mfu": 0.29},
+    }))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "n": 2, "rc": 0,
+        "parsed": {"skipped": True, "reason": "tpu_backend_unavailable",
+                   "error": "UNAVAILABLE: lease lapsed"},
+    }))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+        "n": 3, "rc": 1, "parsed": None,
+    }))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "metric": "m", "value": 16669.0, "mfu": 0.41,
+        "serve_qps_sustained": 905.0, "trace_overhead_pct": 1.2,
+        "gate": {"ok": False, "failed": ["serve_p99_ms"]},
+    }))
+    # Unpadded and two-digit rounds must sort NUMERICALLY (r10 after
+    # r9), not by filename.
+    (tmp_path / "BENCH_r9.json").write_text(json.dumps({
+        "metric": "m", "value": 1.0,
+    }))
+    (tmp_path / "BENCH_r10.json").write_text(json.dumps({
+        "metric": "m", "value": 2.0,
+    }))
+    # Valid JSON that is not a record (a corrupted write): an
+    # unparseable round, never an AttributeError.
+    (tmp_path / "BENCH_r11.json").write_text("[1, 2, 3]")
+    rows = load_rounds(d)
+    assert [r["round"] for r in rows] == [
+        "r01", "r02", "r03", "r04", "r09", "r10", "r11",
+    ]
+    assert [r["status"] for r in rows][:4] == [
+        "ok", "skipped", "unparseable", "ok",
+    ]
+    assert rows[-1]["status"] == "unparseable"
+    assert "list JSON" in rows[-1]["reason"]
+    assert rows[1]["reason"] == "tpu_backend_unavailable"
+    assert "rc=1" in rows[2]["reason"]
+    assert rows[3]["gate_ok"] is False
+    table = format_history(rows)
+    lines = table.splitlines()
+    assert len(lines) == 8  # header + one line per round, none vanish
+    assert "tpu_backend_unavailable" in table
+    assert "FAIL serve_p99_ms" in table
+    cli_main(["bench-history", d])
+    assert "r03    unparseable" in capsys.readouterr().out
+    cli_main(["bench-history", d, "--json"])
+    out_rows = [json.loads(ln) for ln in
+                capsys.readouterr().out.strip().splitlines()]
+    assert out_rows[0]["value"] == 2372.3
+    # An empty dir renders a named absence, not a crash.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert "no BENCH_r*.json" in format_history(load_rounds(str(empty)),
+                                                bench_dir=str(empty))
