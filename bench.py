@@ -365,6 +365,20 @@ def _measure_round(platform: str) -> dict:
         trace_row = measure_trace_overhead(cfg)
     except Exception as e:
         trace_row = {"trace_overhead_error": repr(e)[:500]}
+    # Serving-fleet robustness row (featurenet_tpu.fleet.loadgen): a
+    # 2-replica CPU fleet (replicas forced onto JAX_PLATFORMS=cpu —
+    # this row pins the ROUTER layer, deliberately independent of
+    # accelerator health) under open-loop load with one replica
+    # SIGKILLed a third of the way in. fleet_qps_sustained must hold
+    # through the loss and fleet_requests_dropped is pinned at ZERO;
+    # a failure degrades to an absent key with the error in-artifact.
+    fleet_row: dict = {}
+    try:
+        from featurenet_tpu.fleet.loadgen import bench_fleet
+
+        fleet_row = bench_fleet()
+    except Exception as e:
+        fleet_row = {"fleet_error": repr(e)[:500]}
     # Scaling-efficiency gate rows (the MULTICHIP_r0*.json series made
     # self-policing): per-chip train throughput at every power-of-two
     # mesh shape this session's devices allow, plus the cross-host
@@ -572,6 +586,10 @@ def _measure_round(platform: str) -> dict:
         # overload rejections.
         **serve_row,
         **trace_row,
+        # Fleet robustness row (fleet.loadgen.bench_fleet): router-level
+        # sustained QPS / p99 through a mid-run replica kill, dropped
+        # admitted requests (pinned 0), spillover/re-submit counts.
+        **fleet_row,
         **scaling_rows,
         **e2e,
     }
@@ -644,6 +662,12 @@ def _measure_round(platform: str) -> dict:
         # relative tolerance on ~0 would pin "never change" — the gate
         # is for a host falling behind by whole percentage points.
         ("data_wait_spread", 0.1),
+        # The fleet p99 crosses a replica kill + re-submit, so it
+        # carries the recovery transient by design — absolute room like
+        # the serve pins. fleet_requests_dropped deliberately gets NO
+        # slack: its baseline is 0 and any drop is a real regression of
+        # the fleet's central promise.
+        ("fleet_p99_ms", 25.0),
     ):
         pin = out["gate_summary"]["gates"].get(noisy)
         if pin is not None:

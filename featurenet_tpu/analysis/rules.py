@@ -242,7 +242,14 @@ HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py",
                     # device — a host sync creeping in here would wedge
                     # the one process whose job is to outlive the mesh.
                     "elastic/coordinator.py", "elastic/membership.py",
-                    "elastic/planner.py")
+                    "elastic/planner.py",
+                    # The serving fleet inherits the same contract: the
+                    # router/manager process must survive every replica,
+                    # so it owns no device and every request it touches
+                    # stays bytes — a host sync here would couple the
+                    # fleet's availability to one child's backend.
+                    "fleet/replica.py", "fleet/router.py",
+                    "fleet/loadgen.py")
 
 
 def _is_host_sync(node: ast.Call) -> Optional[str]:
@@ -489,6 +496,7 @@ FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     # fields and map 1:1).
     "world_size": (),
     "local_devices": (),
+    "readmit": (),            # boundary re-admission policy (coordinator)
     "elastic_rank": (),       # internal: child's rank in the generation
     "elastic_world": (),      # internal: generation world size
     "elastic_port": (),       # internal: jax.distributed coordinator port

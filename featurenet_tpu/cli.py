@@ -231,6 +231,16 @@ def _add_supervise_flags(p: argparse.ArgumentParser) -> None:
                         "planner's feasibility input: every admitted "
                         "world's device count must divide global_batch "
                         "(default 1)")
+    p.add_argument("--readmit", choices=["auto", "agent"], default="auto",
+                   help="(--elastic) boundary re-admission policy: "
+                        "'auto' (default) re-offers every lost slot at "
+                        "the next generation boundary; 'agent' "
+                        "re-admits only slots whose external host "
+                        "agent signaled recovery by writing its slot "
+                        "into membership.json "
+                        "(elastic.membership.signal_ready) — a "
+                        "still-dead host is never blindly offered a "
+                        "rank it cannot fill")
     # Internal: injected by the elastic coordinator on each child so the
     # child joins the generation's jax.distributed world.
     p.add_argument("--elastic-rank", type=int, help=argparse.SUPPRESS)
@@ -663,6 +673,35 @@ def main(argv=None) -> None:
                             "depth are fast-rejected with a structured "
                             "overload response instead of queueing "
                             "without bound (default 64)")
+    p_srv.add_argument("--batch-queue-limit", type=int, default=None,
+                       dest="batch_queue_limit",
+                       help="per-lane admission cap for batch-priority "
+                            "requests (X-Featurenet-Priority: batch): "
+                            "the batch lane rejects at this depth even "
+                            "while the global queue has room, so under "
+                            "pressure batch sheds FIRST (default: half "
+                            "of --queue-limit)")
+    p_srv.add_argument("--replica-id", default=None, dest="replica_id",
+                       help="this replica's fleet identity: echoed in "
+                            "overload error bodies and /healthz so the "
+                            "fleet router (or a client holding a 503) "
+                            "can name WHICH backend answered; set by "
+                            "`cli fleet` on each child")
+    p_srv.add_argument("--heartbeat-file", dest="heartbeat_file",
+                       help="touch this file once a second while the "
+                            "service is ready (the fleet replica "
+                            "manager's liveness protocol — the shared "
+                            "heartbeat/stall state machine that also "
+                            "watches training children)")
+    p_srv.add_argument("--inject-faults", dest="inject_faults",
+                       help="chaos spec (see `train --inject-faults`); "
+                            "serving sites: replica_slow@request=N "
+                            "drags this replica's Nth forward by the "
+                            "latency-injection sleep")
+    # Internal: which per-host event stream this process owns (the fleet
+    # launcher gives each replica its own stream; the router keeps 0).
+    p_srv.add_argument("--process-index", type=int, default=None,
+                       dest="process_index", help=argparse.SUPPRESS)
     p_srv.add_argument("--host", default="127.0.0.1")
     p_srv.add_argument("--port", type=int, default=8000,
                        help="HTTP port (0 = ephemeral; the bound port is "
@@ -703,6 +742,80 @@ def main(argv=None) -> None:
                        help="persistent AOT executable cache: the bucket "
                             "ladder's warmup deserializes instead of "
                             "compiling on later cold starts")
+    p_flt = sub.add_parser("fleet", allow_abbrev=False,
+                           help="elastic serving fleet "
+                                "(featurenet_tpu.fleet): N supervised "
+                                "`cli serve` replicas behind one router "
+                                "— health-gated least-queue routing, "
+                                "overload spillover, re-submit-once on "
+                                "replica loss, priority-lane shedding, "
+                                "Retry-After backoff, advisory "
+                                "fleet_scale verdicts")
+    p_flt.add_argument("--checkpoint-dir", required=True)
+    p_flt.add_argument("--replicas", type=int, default=2,
+                       help="serving replicas to run (default 2); each "
+                            "is a supervised `cli serve --port 0` child "
+                            "that rejoins the roster only after its "
+                            "/healthz turns ready")
+    p_flt.add_argument("--buckets", default="1,4,16,64",
+                       help="per-replica bucket ladder (see `serve "
+                            "--buckets`)")
+    p_flt.add_argument("--max-wait-ms", type=float, default=5.0,
+                       dest="max_wait_ms",
+                       help="per-replica flush deadline (see `serve`)")
+    p_flt.add_argument("--queue-limit", type=int, default=64,
+                       dest="queue_limit",
+                       help="per-replica admission bound (see `serve`)")
+    p_flt.add_argument("--batch-shed-depth", type=int, default=8,
+                       dest="batch_shed_depth",
+                       help="router-level batch-lane pressure bar: a "
+                            "batch request is forwarded only to a "
+                            "replica whose load score sits under this; "
+                            "above it on every replica, batch sheds "
+                            "immediately with Retry-After (default 8)")
+    p_flt.add_argument("--host", default="127.0.0.1")
+    p_flt.add_argument("--port", type=int, default=8000,
+                       help="router HTTP port (0 = ephemeral; printed "
+                            "in the startup line)")
+    p_flt.add_argument("--slo-p99-ms", type=float, default=250.0,
+                       dest="slo_p99_ms",
+                       help="fleet end-to-end p99 SLO: drives the "
+                            "router's serving alert rules and the "
+                            "advisory fleet_scale verdicts "
+                            "(default 250)")
+    p_flt.add_argument("--precision", choices=["fp32", "bf16", "int8"],
+                       default=None,
+                       help="replica serving precision (see `serve`)")
+    p_flt.add_argument("--duration-s", type=float, default=None,
+                       dest="duration_s",
+                       help="serve for this long then drain and exit "
+                            "(default: until SIGTERM/SIGINT)")
+    p_flt.add_argument("--drain", action="store_true",
+                       help="gate the exit code on the drain verdict: "
+                            "exit 2 on an unresolved fleet serving "
+                            "alert OR any dropped admitted request")
+    p_flt.add_argument("--run-dir", dest="run_dir",
+                       help="observability directory: the router owns "
+                            "stream 0 (fleet_* events, roster "
+                            "membership.json, scale verdicts); each "
+                            "replica writes events.<slot+1>.jsonl into "
+                            "the same dir")
+    p_flt.add_argument("--exec-cache-dir", dest="exec_cache_dir",
+                       help="fleet-SHARED persistent executable cache: "
+                            "the first replica's compiles warm every "
+                            "later replica and every respawn — rejoin "
+                            "is seconds, not minutes")
+    p_flt.add_argument("--trace-sample", type=float, dest="trace_sample",
+                       help="replica request-tracing sample rate (see "
+                            "`serve --trace-sample`)")
+    p_flt.add_argument("--inject-faults", dest="inject_faults",
+                       help="chaos spec (featurenet_tpu.faults): "
+                            "replica_loss@request=N SIGKILLs a live "
+                            "replica at the router's Nth routed "
+                            "request; replica_slow@request=N drags one "
+                            "replica's Nth forward; spawn_fail fires "
+                            "in the manager — child-side sites fire in "
+                            "the replicas")
     args = parser.parse_args(argv)
 
     if args.cmd == "programs":
@@ -959,6 +1072,7 @@ def main(argv=None) -> None:
             local_devices=args.local_devices,
             stall_timeout_s=args.stall_timeout,
             max_reforms=args.max_restarts,
+            readmit=args.readmit,
         ).run()
         print(json.dumps({"elastic": {
             "exit_code": result.exit_code,
@@ -1409,12 +1523,24 @@ def main(argv=None) -> None:
                 rules = parse_rules(args.alert_rules)
             except ValueError as e:
                 raise SystemExit(f"--alert-rules: {e}")
+        if getattr(args, "inject_faults", None):
+            # The replica side of the fleet chaos specs (replica_slow
+            # fires in InferenceService._forward); markers in run_dir
+            # keep a respawned replica from re-firing a taken fault.
+            from featurenet_tpu import faults
+
+            try:
+                faults.install(args.inject_faults,
+                               state_dir=getattr(args, "run_dir", None))
+            except ValueError as e:
+                raise SystemExit(f"--inject-faults: {e}")
         if getattr(args, "run_dir", None):
             from featurenet_tpu import obs
             from featurenet_tpu.config import config_to_dict
 
             obs.init_run(args.run_dir, config=config_to_dict(cfg),
-                         extra={"cmd": "serve"})
+                         extra={"cmd": "serve"},
+                         process_index=args.process_index)
         # Construction IS the warmup: one serve executable per bucket
         # builds (or loads from the exec cache) before the socket opens.
         pred = Predictor.from_checkpoint(
@@ -1425,7 +1551,24 @@ def main(argv=None) -> None:
             pred, buckets=buckets, max_wait_ms=args.max_wait_ms,
             queue_limit=args.queue_limit, rules=rules,
             slo_p99_ms=args.slo_p99_ms,
+            batch_queue_limit=args.batch_queue_limit,
+            replica=args.replica_id,
         )
+        hb_stop = threading.Event()
+        if args.heartbeat_file:
+            # The fleet liveness protocol: beat once a second WHILE
+            # ready — a wedged replica stops beating and the manager's
+            # heartbeat monitor (the trainer's stall machine) kills it.
+            from featurenet_tpu.train.heartbeat import touch_heartbeat
+
+            def _beat():
+                while not hb_stop.is_set():
+                    if service.ready():
+                        touch_heartbeat(args.heartbeat_file)
+                    hb_stop.wait(1.0)
+
+            threading.Thread(target=_beat, name="serve-heartbeat",
+                             daemon=True).start()
         srv = make_server(service, host=args.host, port=args.port)
         server_thread = threading.Thread(
             target=srv.serve_forever, name="serve-http", daemon=True
@@ -1436,6 +1579,7 @@ def main(argv=None) -> None:
             "buckets": list(buckets), "max_wait_ms": args.max_wait_ms,
             "queue_limit": args.queue_limit, "precision": pred.precision,
             "trace_sample": cfg.trace_sample,
+            "replica": args.replica_id,
             "endpoints": _ENDPOINTS,
         }}), flush=True)
         stop = threading.Event()
@@ -1452,6 +1596,7 @@ def main(argv=None) -> None:
         finally:
             for sig, h in prev_handlers.items():
                 signal.signal(sig, h)
+        hb_stop.set()
         srv.shutdown()
         st = service.drain()
         if getattr(args, "run_dir", None):
@@ -1459,6 +1604,90 @@ def main(argv=None) -> None:
 
             obs.close_run()
         print(json.dumps({"serve_stats": st}))
+        if args.drain and st["exit_code"]:
+            raise SystemExit(st["exit_code"])
+        return
+
+    if args.cmd == "fleet":
+        import signal
+        import threading
+
+        from featurenet_tpu import faults, obs
+        from featurenet_tpu.fleet.loadgen import replica_argv
+        from featurenet_tpu.fleet.replica import ReplicaManager
+        from featurenet_tpu.fleet.router import FleetRouter
+
+        if args.replicas < 1:
+            raise SystemExit(
+                f"fleet: --replicas must be >= 1, got {args.replicas}"
+            )
+        if not getattr(args, "run_dir", None):
+            raise SystemExit(
+                "fleet: --run-dir is required — the roster "
+                "(membership.json), per-replica heartbeats, stdout "
+                "banners, and the fleet event stream all live there"
+            )
+        if getattr(args, "inject_faults", None):
+            # The router/manager process installs only its own sites
+            # (replica_loss fires at the Nth routed request, spawn_fail
+            # in the manager); child-side sites fire in the replicas,
+            # which receive the full spec on their argv.
+            try:
+                faults.install(args.inject_faults, state_dir=args.run_dir,
+                               only={"replica_loss", "spawn_fail"})
+            except ValueError as e:
+                raise SystemExit(f"--inject-faults: {e}")
+        obs.init_run(args.run_dir, extra={"cmd": "fleet"},
+                     process_index=0)
+
+        def spawn(slot, hb):
+            return replica_argv(
+                args.checkpoint_dir, slot, hb, run_dir=args.run_dir,
+                exec_cache_dir=args.exec_cache_dir,
+                buckets=args.buckets, max_wait_ms=args.max_wait_ms,
+                queue_limit=args.queue_limit,
+                slo_p99_ms=args.slo_p99_ms, precision=args.precision,
+                inject_faults=args.inject_faults,
+                trace_sample=args.trace_sample,
+            )
+
+        manager = ReplicaManager(args.replicas, spawn, args.run_dir,
+                                 host="127.0.0.1")
+        router = FleetRouter(
+            manager, slo_p99_ms=args.slo_p99_ms,
+            batch_shed_depth=args.batch_shed_depth,
+        )
+        manager.start()
+        srv = router.make_server(host=args.host, port=args.port)
+        obs.emit("fleet_start", replicas=args.replicas,
+                 host=srv.server_address[0], port=srv.server_address[1])
+        threading.Thread(target=srv.serve_forever, name="fleet-http",
+                         daemon=True).start()
+        print(json.dumps({"fleet": {
+            "host": srv.server_address[0], "port": srv.server_address[1],
+            "replicas": args.replicas, "buckets": args.buckets,
+            "batch_shed_depth": args.batch_shed_depth,
+            "run_dir": args.run_dir,
+        }}), flush=True)
+        stop = threading.Event()
+        prev_handlers = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(
+                    sig, lambda *_: stop.set()
+                )
+            except ValueError:
+                pass
+        try:
+            stop.wait(timeout=args.duration_s)
+        finally:
+            for sig, h in prev_handlers.items():
+                signal.signal(sig, h)
+        srv.shutdown()
+        st = router.drain()
+        manager.stop()
+        obs.close_run()
+        print(json.dumps({"fleet_stats": st}))
         if args.drain and st["exit_code"]:
             raise SystemExit(st["exit_code"])
         return

@@ -31,6 +31,8 @@ from featurenet_tpu.elastic.membership import (  # noqa: F401
     MEMBERSHIP_FILENAME,
     Membership,
     read_membership,
+    ready_slots,
+    signal_ready,
     write_membership,
 )
 from featurenet_tpu.elastic.planner import (  # noqa: F401

@@ -56,7 +56,12 @@ import time
 from typing import Callable, Optional, Sequence
 
 from featurenet_tpu import faults
-from featurenet_tpu.elastic.membership import Membership, write_membership
+from featurenet_tpu.elastic.membership import (
+    Membership,
+    read_membership,
+    ready_slots,
+    write_membership,
+)
 from featurenet_tpu.elastic.planner import InfeasibleWorld, plan_world
 # One heartbeat/stall state machine for both watchers: the coordinator
 # drives one HeartbeatMonitor per slot, the plain supervisor drives one
@@ -126,6 +131,9 @@ class ElasticCoordinator:
         supervisor's knobs, applied per slot.
       max_reforms: unplanned re-forms (loss, full restart, startup
         retry) allowed before giving up; planned boundaries are free.
+      readmit: boundary re-admission policy — "auto" re-offers every
+        lost slot, "agent" only slots that signaled recovery via
+        ``membership.signal_ready`` (external host agents).
       env: environment for every child (None = inherit).
     """
 
@@ -144,11 +152,16 @@ class ElasticCoordinator:
         max_reforms: int = 8,
         backoff_base_s: float = 1.0,
         backoff_cap_s: float = 60.0,
+        readmit: str = "auto",
         env: Optional[dict] = None,
         log=print,
     ):
         if n_hosts < 1:
             raise ValueError(f"n_hosts must be >= 1, got {n_hosts}")
+        if readmit not in ("auto", "agent"):
+            raise ValueError(
+                f"readmit must be 'auto' or 'agent', got {readmit!r}"
+            )
         self.n_hosts = n_hosts
         self.spawn = spawn
         self.run_dir = os.path.abspath(run_dir)
@@ -163,6 +176,12 @@ class ElasticCoordinator:
         self.max_reforms = max_reforms
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        # Re-admission policy at generation boundaries: "auto" blindly
+        # re-offers every lost slot (a still-dead host fails startup and
+        # is shed again — costs a reform); "agent" re-admits only slots
+        # whose external agent signaled recovery via the membership file
+        # (membership.signal_ready) — the carried ROADMAP follow-on.
+        self.readmit = readmit
         self.env = env
         self.log = log
         self._spawns = 0
@@ -353,11 +372,19 @@ class ElasticCoordinator:
                 }))
                 if prev_n:
                     reforms += 1
+            # Preserve the agent readiness signals of slots still out of
+            # the mesh (the write replaces the whole document); a signal
+            # for a slot now serving is consumed by its admission.
+            prev = read_membership(self.run_dir)
+            pending = tuple(sorted(
+                set(prev.ready) - set(members)
+            )) if prev is not None else ()
             write_membership(self.run_dir, Membership(
                 generation=generation,
                 members=tuple(members),
                 min_world_size=self.min_world_size,
                 reason=reason,
+                ready=pending,
             ))
             out = self._run_generation(
                 members, generation, _free_port(), record
@@ -384,16 +411,22 @@ class ElasticCoordinator:
                 prev_n = len(members)
                 if lost:
                     # The generation boundary is where recovered hosts
-                    # rejoin: every lost slot is offered the next world;
-                    # one that is still dead fails startup and is shed
-                    # again without taking the run down.
-                    for slot in sorted(lost):
+                    # rejoin. "auto" offers every lost slot the next
+                    # world (one still dead fails startup and is shed
+                    # again without taking the run down); "agent" admits
+                    # only the slots whose recovery agent signaled
+                    # readiness into membership.json — the rest stay
+                    # shed until they do.
+                    back = sorted(lost) if self.readmit == "auto" else \
+                        sorted(s for s in lost
+                               if s in ready_slots(self.run_dir))
+                    for slot in back:
                         sink.emit("host_join", host=slot,
                                   generation=generation)
                         rejoins += 1
-                    avail |= set(lost)
-                    lost.clear()
-                    reason = "host_rejoin"
+                        del lost[slot]
+                    avail |= set(back)
+                    reason = "host_rejoin" if back else "planned"
                 else:
                     reason = "planned"
                 continue
@@ -420,7 +453,10 @@ class ElasticCoordinator:
                     # Full-world loss: below the floor there is no mesh
                     # to shrink to — re-admit everything and restart at
                     # strength (the plain supervisor's move), still on
-                    # the reform budget.
+                    # the reform budget. Even under readmit="agent":
+                    # waiting for signals here would idle the whole run
+                    # on agents that may never come; a still-dead slot
+                    # fails startup and is shed again.
                     for slot in sorted(lost):
                         sink.emit("host_join", host=slot,
                                   generation=generation + 1)

@@ -28,12 +28,21 @@ MEMBERSHIP_FILENAME = "membership.json"
 @dataclasses.dataclass(frozen=True)
 class Membership:
     """One generation's mesh: the slot ids that form it, ordered — the
-    rank of a member is its index in ``members``."""
+    rank of a member is its index in ``members``.
+
+    ``ready`` is the external-agent re-admission channel: a recovered
+    host's agent writes its slot here (``signal_ready``) to ask back in,
+    and a coordinator running ``readmit="agent"`` re-admits ONLY
+    signaled slots at the next generation boundary — a still-dead host
+    is never blindly re-offered a rank it can't fill. The serving
+    fleet's roster reuses the same document shape (members = ready
+    replicas, reason = replica_loss / replica_rejoin)."""
 
     generation: int
     members: tuple[int, ...]
     min_world_size: int
     reason: str  # "start" | "host_loss" | "host_rejoin" | "planned" | ...
+    ready: tuple[int, ...] = ()  # slots that signaled recovery
 
     @property
     def world_size(self) -> int:
@@ -56,6 +65,7 @@ def write_membership(run_dir: str, m: Membership) -> str:
         "members": list(m.members),
         "min_world_size": m.min_world_size,
         "reason": m.reason,
+        "ready": list(m.ready),
     }
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
@@ -78,6 +88,41 @@ def read_membership(run_dir: str) -> Optional[Membership]:
             members=tuple(int(s) for s in doc["members"]),
             min_world_size=int(doc.get("min_world_size", 1)),
             reason=str(doc.get("reason", "")),
+            # Absent in pre-agent documents: an old membership.json must
+            # keep reading (no signals is exactly what it means).
+            ready=tuple(int(s) for s in doc.get("ready", ())),
         )
     except (OSError, ValueError, KeyError, TypeError):
         return None
+
+
+def signal_ready(run_dir: str, slot: int) -> bool:
+    """The external host agent's half of the re-admission protocol: mark
+    ``slot`` ready in the membership file. Returns True when the signal
+    is durably recorded (or the slot already serves in the current
+    generation — nothing to signal); False when no membership exists yet
+    to signal against (the agent should poll again).
+
+    The write is read-modify-replace on the atomic writer. A coordinator
+    re-form racing this write can drop a just-landed signal — the agent
+    polls ``membership.json`` anyway (that is how it learned it was shed)
+    and re-signals until a generation admits it, so a lost signal costs
+    one boundary, never the run."""
+    m = read_membership(run_dir)
+    if m is None:
+        return False
+    slot = int(slot)
+    if slot in m.members or slot in m.ready:
+        return True
+    write_membership(run_dir, dataclasses.replace(
+        m, ready=tuple(sorted(set(m.ready) | {slot}))
+    ))
+    return True
+
+
+def ready_slots(run_dir: str) -> set[int]:
+    """The slots whose agents signaled recovery (empty when no
+    membership exists or none signaled) — what a ``readmit="agent"``
+    coordinator consults at each generation boundary."""
+    m = read_membership(run_dir)
+    return set(m.ready) if m is not None else set()

@@ -85,6 +85,16 @@ SITES = {
     # elastic coordinator must detect and shrink around. Last host (not
     # first) so host 0's event stream and run.json survive the loss.
     "host_loss": "step",                 # exact train-loop step number
+    # Serving-fleet sites (featurenet_tpu.fleet). replica_loss fires in
+    # the ROUTER process at the Nth routed request and SIGKILLs a live
+    # replica mid-stream — no drain, in-flight requests die with it;
+    # exactly what the router's re-submit-once path must absorb with
+    # zero admitted-request drops. replica_slow fires in a REPLICA
+    # (InferenceService._forward) at its Nth dispatched batch and drags
+    # the forward by SLOW_SLEEP_S — latency, not death: the shape the
+    # least-queue-depth routing and the p99 gate must ride out.
+    "replica_loss": "request",           # Nth routed fleet request
+    "replica_slow": "request",           # Nth replica forward dispatch
 }
 
 # How long the latency-injection sites (producer_slow, save_slow) sleep

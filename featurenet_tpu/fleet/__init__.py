@@ -1,0 +1,43 @@
+"""Elastic serving fleet (ROADMAP item 2): N replicas behind one router.
+
+``cli serve`` is one ``InferenceService`` on one host — a single process
+crash takes the whole workload down, which is exactly the failure class
+the training side already survives (the elastic coordinator). This
+package composes the pieces that already exist — batcher admission,
+``/healthz`` readiness, the membership file, coordinator-style
+heartbeat/exit-code supervision, trace-id propagation — into one
+fault-tolerant serving layer:
+
+- ``replica``: the replica manager — N ``cli serve --port 0`` subprocess
+  children, each supervised by the shared heartbeat state machine
+  (``train.heartbeat``) plus exit-code polling; a dead or wedged replica
+  is SIGKILLed and respawned, and rejoins the roster only after its
+  ``/healthz`` turns ready (warming from the fleet-shared
+  ``--exec-cache-dir``, so rejoin is seconds, not minutes). The roster
+  is durably mirrored into ``membership.json`` — the same document
+  schema the elastic trainer writes.
+- ``router``: the HTTP front end — health-gated least-queue-depth
+  routing fed by each replica's ``/healthz``, spillover admission (a
+  replica's overload 503 becomes "try the next healthy replica", trace
+  id preserved), re-submit-once on replica loss (classification is
+  pure, so a re-submitted request is idempotent), priority-lane
+  shedding (``batch`` sheds first), fleet-wide 503 + ``Retry-After``
+  only when every lane is full, and advisory SLO-driven scaling
+  verdicts (``fleet_scale{verdict: add|shed|hold}``) off the rolling
+  serving windows.
+- ``loadgen``: the open-loop HTTP load generator (honors
+  ``Retry-After``) and the bench entry point that pins
+  ``fleet_qps_sustained`` / ``fleet_p99_ms`` / ``fleet_requests_dropped``
+  through a mid-run replica kill.
+
+Launch with ``cli fleet --replicas N --checkpoint-dir D --run-dir R``.
+"""
+
+from featurenet_tpu.fleet.replica import (  # noqa: F401
+    Candidate,
+    ReplicaManager,
+)
+from featurenet_tpu.fleet.router import (  # noqa: F401
+    FleetRouter,
+    scale_verdict,
+)

@@ -1,0 +1,293 @@
+"""Open-loop HTTP load generation for the fleet, and the bench row.
+
+``serve.loadgen`` drives one in-process service; the fleet's contract is
+an HTTP boundary, so this generator speaks the wire: Poisson arrivals
+POSTed to the router's ``/predict_voxels`` (raw float32 grid bytes — no
+per-request geometry work, so the generator measures the serving path,
+not the client's voxelizer), each with a minted trace id and a priority
+lane header. A 503 carrying ``Retry-After`` is honored ONCE (sleep the
+hinted backoff, retry) before counting as a rejection — the polite-
+client half of the admission contract.
+
+``bench_fleet`` is the bench.py entry point: a 2-replica CPU fleet
+(replicas forced onto ``JAX_PLATFORMS=cpu`` — the row pins the ROUTER
+layer's robustness, deliberately independent of accelerator health),
+open-loop load with one replica SIGKILLed mid-run, returning the pinned
+``fleet_qps_sustained`` / ``fleet_p99_ms`` / ``fleet_requests_dropped``
+fields — the last with a baseline of 0: the fleet's whole promise is
+that admitted work survives replica loss.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from featurenet_tpu.obs import tracing as _tracing
+from featurenet_tpu.obs.report import _pct
+from featurenet_tpu.obs.tracing import TRACE_HEADER
+from featurenet_tpu.fleet.router import post_once
+from featurenet_tpu.serve.http import PRIORITY_HEADER
+
+
+def _post(host: str, port: int, path: str, body: bytes, lane: str,
+          timeout_s: float) -> tuple[int, dict, Optional[float]]:
+    """One POST; returns (status, parsed body, Retry-After seconds).
+    Connection-level failures raise OSError/HTTPException upward.
+    Rides the router's ``post_once`` — one hop implementation for the
+    whole fleet package."""
+    status, raw, ra = post_once(host, port, path, body, {
+        TRACE_HEADER: _tracing.mint_trace_id(),
+        PRIORITY_HEADER: lane,
+    }, timeout_s)
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+    except ValueError:
+        doc = {}
+    return status, doc, ra
+
+
+def http_load(host: str, port: int, qps: float, n_requests: int,
+              grids: np.ndarray, lane: str = "interactive",
+              rng: Optional[np.random.Generator] = None,
+              timeout_s: float = 60.0,
+              honor_retry_after: bool = True,
+              max_workers: int = 32) -> tuple[dict, list]:
+    """Drive the router at ``host:port`` with ``n_requests`` Poisson
+    arrivals at rate ``qps``; returns ``(stats, outcomes)`` where
+    ``outcomes[i]`` records request i's final status, client latency,
+    and label. Open-loop: arrivals are pre-scheduled; a slow fleet is
+    submitted to late but never slower. Every request runs on a worker
+    thread (the HTTP POST blocks for the full serving latency — the
+    thread pool is the client's concurrency, not the load's clock)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    if qps <= 0:
+        raise ValueError(f"qps must be > 0, got {qps}")
+    if rng is None:
+        rng = np.random.default_rng(0)
+    payloads = [
+        # lint: allow-host-sync(client-side wire encoding of host arrays)
+        np.ascontiguousarray(
+            g.reshape(g.shape[:3]), dtype="<f4"
+        ).tobytes()
+        for g in grids
+    ]
+    arrivals = np.cumsum(rng.exponential(1.0 / qps, size=n_requests))
+    outcomes: list[Optional[dict]] = [None] * n_requests
+
+    def one(i: int) -> None:
+        t_submit = time.perf_counter()
+        body = payloads[i % len(payloads)]
+        try:
+            status, doc, ra = _post(host, port, "/predict_voxels",
+                                    body, lane, timeout_s)
+            retried = False
+            if status == 503 and honor_retry_after and ra:
+                # The polite client: the server said when to come back.
+                time.sleep(ra)
+                retried = True
+                # Restamp the latency clock: the backoff sleep is
+                # server-DIRECTED waiting, not serving latency — folding
+                # it into latency_ms would swing the gate-pinned
+                # fleet_p99_ms by the whole Retry-After on every round
+                # whose kill lands slightly differently.
+                t_submit = time.perf_counter()
+                status, doc, ra = _post(host, port, "/predict_voxels",
+                                        body, lane, timeout_s)
+        except (OSError, http.client.HTTPException) as e:
+            outcomes[i] = {"status": None, "error": str(e)}
+            return
+        outcomes[i] = {
+            "status": status,
+            "latency_ms": (time.perf_counter() - t_submit) * 1e3,
+            "label": doc.get("label"),
+            "retried": retried,
+            "body": doc,
+        }
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futs = []
+        for i in range(n_requests):
+            ahead = arrivals[i] - (time.perf_counter() - t0)
+            if ahead > 0:
+                time.sleep(ahead)
+            futs.append(pool.submit(one, i))
+        for f in futs:
+            f.result()
+    wall = time.perf_counter() - t0
+    done = [o for o in outcomes if o is not None]
+    ok = [o for o in done if o.get("status") == 200]
+    rejected = sum(1 for o in done if o.get("status") == 503)
+    # A drop is a request the fleet LOST: any 5xx that is not a clean
+    # 503 rejection (502 = re-submit exhausted, 500 = forward error,
+    # 504 = admitted but unanswered) or a connection death against the
+    # router itself (status None).
+    dropped = sum(
+        1 for o in done
+        if o.get("status") is None
+        or (o["status"] >= 500 and o["status"] != 503)
+    )
+    lats = sorted(o["latency_ms"] for o in ok)
+    stats = {
+        "offered_qps": round(n_requests / float(arrivals[-1]), 1),
+        "sustained_qps": round(len(ok) / wall, 1) if wall > 0 else None,
+        "answered": len(ok),
+        "rejected": rejected,
+        "dropped": dropped,
+        "retried": sum(1 for o in done if o.get("retried")),
+        "p50_ms": round(_pct(lats, 50), 3) if lats else None,
+        "p99_ms": round(_pct(lats, 99), 3) if lats else None,
+    }
+    return stats, outcomes
+
+
+def replica_argv(ckpt_dir: str, slot: int, heartbeat_file: str, *,
+                 run_dir: Optional[str] = None,
+                 exec_cache_dir: Optional[str] = None,
+                 buckets: str = "1,4", max_wait_ms: float = 5.0,
+                 queue_limit: int = 64,
+                 slo_p99_ms: float = 250.0,
+                 precision: Optional[str] = None,
+                 inject_faults: Optional[str] = None,
+                 trace_sample: Optional[float] = None) -> list:
+    """One replica's spawn argv (shared by ``cli fleet`` and
+    ``bench_fleet`` so the two can never drift on the child contract):
+    ``cli serve --port 0`` with the fleet identity flags — replica id,
+    per-slot heartbeat file, per-slot event stream (``--process-index
+    slot+1``; the router owns stream 0)."""
+    argv = [
+        sys.executable, "-m", "featurenet_tpu.cli", "serve",
+        "--checkpoint-dir", ckpt_dir, "--port", "0",
+        "--buckets", buckets, "--max-wait-ms", str(max_wait_ms),
+        "--queue-limit", str(queue_limit),
+        "--slo-p99-ms", str(slo_p99_ms),
+        "--replica-id", str(slot),
+        "--heartbeat-file", heartbeat_file,
+        "--process-index", str(slot + 1),
+    ]
+    if run_dir:
+        argv += ["--run-dir", run_dir]
+    if exec_cache_dir:
+        argv += ["--exec-cache-dir", exec_cache_dir]
+    if precision:
+        argv += ["--precision", precision]
+    if inject_faults:
+        argv += ["--inject-faults", inject_faults]
+    if trace_sample is not None:
+        argv += ["--trace-sample", str(trace_sample)]
+    return argv
+
+
+def _train_tiny_checkpoint(ckpt_dir: str, env: dict) -> None:
+    """A 2-step smoke16 checkpoint in a CPU subprocess (the bench parent
+    may own an accelerator; this row must not touch it)."""
+    code = (
+        "from featurenet_tpu.config import get_config\n"
+        "from featurenet_tpu.train.loop import Trainer\n"
+        "cfg = get_config('smoke16', total_steps=2, checkpoint_every=2,"
+        " eval_every=10**9, log_every=2, data_workers=1,"
+        f" checkpoint_dir={ckpt_dir!r})\n"
+        "Trainer(cfg).run()\n"
+    )
+    subprocess.run([sys.executable, "-c", code], env=env, check=True,
+                   capture_output=True, timeout=600)
+
+
+def bench_fleet(replicas: int = 2, qps: float = 60.0,
+                n_requests: int = 240,
+                ckpt_dir: Optional[str] = None,
+                kill_after_fraction: float = 0.33,
+                buckets: str = "1,4",
+                queue_limit: int = 64) -> dict:
+    """The bench.py fleet row: an N-replica CPU fleet under open-loop
+    load with one replica SIGKILLed a third of the way in. Returns the
+    flat ``fleet_*`` fields the gate pins — sustained QPS and p99 must
+    hold THROUGH the loss, and dropped must be zero."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.fleet.replica import ReplicaManager
+    from featurenet_tpu.fleet.router import FleetRouter
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    tmp = tempfile.mkdtemp(prefix="fleet_bench_")
+    run_dir = os.path.join(tmp, "run")
+    cache_dir = os.path.join(tmp, "exec_cache")
+    own_ckpt = ckpt_dir is None
+    if own_ckpt:
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        _train_tiny_checkpoint(ckpt_dir, env)
+
+    def spawn(slot, hb):
+        return replica_argv(
+            ckpt_dir, slot, hb, run_dir=run_dir,
+            exec_cache_dir=cache_dir, buckets=buckets,
+            queue_limit=queue_limit,
+        )
+
+    manager = ReplicaManager(replicas, spawn, run_dir, env=env)
+    router = FleetRouter(manager, rules=())
+    srv = None
+    try:
+        manager.start()
+        deadline = time.monotonic() + 300
+        while manager.ready_count() < replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"fleet warmup timed out: {manager.stats()}"
+                )
+            time.sleep(0.25)
+        srv = router.make_server("127.0.0.1", 0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        grids = generate_batch(np.random.default_rng(0), 16, 16)["voxels"]
+        kill_at = max(1, int(n_requests * kill_after_fraction))
+        done = threading.Event()
+
+        def killer():
+            # The mid-run loss: SIGKILL one live replica once the router
+            # has seen a third of the load (the fault-injection site
+            # drives the same arm from a spec; bench owns its own timing
+            # so a round is never hostage to spec plumbing).
+            while not done.is_set():
+                if router.stats()["routed"] >= kill_at:
+                    manager.kill_one()
+                    return
+                time.sleep(0.05)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        stats, _ = http_load("127.0.0.1", port, qps, n_requests, grids)
+        done.set()
+        kt.join(timeout=1.0)
+        st = router.drain()
+        return {
+            "fleet_replicas": replicas,
+            "fleet_qps_offered": stats["offered_qps"],
+            "fleet_qps_sustained": stats["sustained_qps"],
+            "fleet_p50_ms": stats["p50_ms"],
+            "fleet_p99_ms": stats["p99_ms"],
+            "fleet_requests_dropped": stats["dropped"],
+            "fleet_requests_rejected": stats["rejected"],
+            "fleet_spillovers": st["spillovers"],
+            "fleet_resubmits": st["resubmits"],
+            "fleet_losses": st["replicas"]["losses"],
+            "fleet_rejoins": st["replicas"]["rejoins"],
+            "fleet_requests": n_requests,
+        }
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        manager.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,420 @@
+"""The replica manager: N supervised ``cli serve`` children + the roster.
+
+One replica is one ``cli serve --port 0`` subprocess. The manager owns
+their whole lifecycle with the coordinator's supervision shape
+(``elastic.coordinator``), applied to serving:
+
+- **Spawn**: each child binds an ephemeral port and prints its serving
+  banner (``{"serving": {..., "port": P}}``) to a per-replica stdout
+  file in the run dir; the manager tails that file to learn the port.
+  A child that never banners within ``spawn_timeout_s`` is killed and
+  charged as a startup loss.
+- **Liveness**: exit-code polling (a dead process) plus the shared
+  heartbeat state machine (``train.heartbeat.HeartbeatMonitor`` — the
+  same grace/stall/re-read protocol the train supervisor and the
+  elastic coordinator drive; the serve child touches its heartbeat file
+  once a second while ready). A wedged replica — process alive, HTTP
+  hung — stops beating and is SIGKILLed like a stalled trainer.
+- **Readiness**: the manager probes each replica's ``/healthz`` every
+  poll; a replica routes traffic only while its probe answers 200
+  (ready), and the probed ``queue_depth`` feeds the router's
+  least-queue-depth pick. Loss → respawn (with crash-loop backoff) →
+  the respawned child warms its bucket ladder from the fleet-shared
+  exec cache → rejoins the roster ONLY when ``/healthz`` turns ready.
+- **Roster**: every ready/loss transition rewrites ``membership.json``
+  (``elastic.membership`` — the exact schema the elastic trainer
+  writes: generation counter, member slots, reason) and emits
+  ``fleet_replica_ready`` / ``fleet_replica_loss`` events, so the
+  report's fleet section can render the roster timeline next to the
+  request stream.
+
+Stdlib-only by contract, like the elastic coordinator: the manager
+process supervises N backend-owning children and must never initialize
+a device itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import threading
+import time
+from typing import Callable, Optional
+
+from featurenet_tpu import faults, obs
+from featurenet_tpu.elastic.coordinator import heartbeat_path
+from featurenet_tpu.elastic.membership import Membership, write_membership
+from featurenet_tpu.train.heartbeat import HeartbeatMonitor
+from featurenet_tpu.train.supervisor import _kill_tree
+
+DEFAULT_POLL_S = 0.25
+DEFAULT_GRACE_S = 300.0        # warmup allowance: a cold cache compiles
+DEFAULT_STALL_TIMEOUT_S = 30.0
+DEFAULT_SPAWN_TIMEOUT_S = 300.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One routable replica as the router sees it: where to connect and
+    how loaded it looked at the last probe (``score`` = probed queue
+    depth + the router's own in-flight count — the freshest cheap
+    estimate of who answers soonest)."""
+
+    slot: int
+    host: str
+    port: int
+    score: int
+
+
+class _Replica:
+    """One slot's live state (manager-internal; guarded by the manager
+    lock for the fields router threads touch)."""
+
+    def __init__(self, slot: int):
+        self.slot = slot
+        self.proc: Optional[subprocess.Popen] = None
+        self.out_path: Optional[str] = None
+        self.out_offset = 0
+        self.port: Optional[int] = None
+        self.ready = False
+        self.queue_depth = 0
+        self.inflight = 0
+        self.spawned_t = 0.0
+        self.respawn_due = 0.0
+        self.failures = 0  # consecutive, for backoff
+        self.was_lost = False  # a later ready is a REJOIN
+        self.mon: Optional[HeartbeatMonitor] = None
+        self.probe_inflight = False  # one outstanding probe at a time
+
+
+class ReplicaManager:
+    """Spawn and supervise ``n`` serving replicas; provide the router's
+    health-gated candidate view.
+
+    ``spawn(slot, heartbeat_file) -> argv`` builds one replica's command
+    (the ``cli fleet`` launcher passes through the serve flags plus
+    ``--port 0 --replica-id <slot> --process-index <slot+1>``). The
+    child must print the serve banner on stdout and touch
+    ``heartbeat_file`` while ready.
+    """
+
+    def __init__(self, n: int, spawn: Callable[[int, str], list],
+                 run_dir: str, *,
+                 host: str = "127.0.0.1",
+                 poll_s: float = DEFAULT_POLL_S,
+                 grace_s: float = DEFAULT_GRACE_S,
+                 stall_timeout_s: float = DEFAULT_STALL_TIMEOUT_S,
+                 spawn_timeout_s: float = DEFAULT_SPAWN_TIMEOUT_S,
+                 max_respawns: int = 16,
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 10.0,
+                 probe_timeout_s: float = 2.0,
+                 env: Optional[dict] = None):
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
+        self.n = n
+        self.spawn = spawn
+        self.run_dir = os.path.abspath(run_dir)
+        self.host = host
+        self.poll_s = poll_s
+        self.grace_s = grace_s
+        self.stall_timeout_s = stall_timeout_s
+        self.spawn_timeout_s = spawn_timeout_s
+        self.max_respawns = max_respawns
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.probe_timeout_s = probe_timeout_s
+        self.env = env
+        self._lock = threading.Lock()
+        self._replicas = {slot: _Replica(slot) for slot in range(n)}
+        self._spawns = 0
+        self._losses = 0
+        self._rejoins = 0
+        self._generation = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        os.makedirs(self.run_dir, exist_ok=True)
+        for r in self._replicas.values():
+            self._spawn(r)
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-replicas", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 20.0) -> None:
+        """SIGTERM every child (a serving child drains on SIGTERM), wait
+        briefly, SIGKILL stragglers, stop the supervision thread."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, 4 * self.poll_s))
+        procs = [r.proc for r in self._replicas.values()
+                 if r.proc is not None and r.proc.poll() is None]
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout_s
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                _kill_tree(p)
+
+    # -- spawn / supervision --------------------------------------------------
+    def _spawn(self, r: _Replica) -> None:
+        self._spawns += 1
+        hb = heartbeat_path(self.run_dir, r.slot)
+        r.mon = HeartbeatMonitor(hb, self.stall_timeout_s, self.grace_s)
+        r.mon.reset()
+        argv = list(self.spawn(r.slot, hb))
+        if faults.maybe_fail("spawn_fail", spawn=self._spawns):
+            import sys
+
+            argv = [sys.executable, "-c", "raise SystemExit(13)"]
+        r.out_path = os.path.join(self.run_dir, f"replica.{r.slot}.out")
+        r.out_offset = 0
+        # Truncate-and-redirect: the banner tail below must find THIS
+        # spawn's banner, not a previous incarnation's.
+        fh = open(r.out_path, "wb")
+        try:
+            r.proc = subprocess.Popen(
+                argv, stdout=fh, stderr=subprocess.STDOUT,
+                start_new_session=True, env=self.env,
+            )
+        finally:
+            fh.close()
+        r.port = None
+        r.ready = False
+        r.queue_depth = 0
+        r.spawned_t = time.monotonic()
+        obs.emit("supervisor", phase="spawn", host=r.slot,
+                 pid=r.proc.pid, generation=self._generation)
+
+    def _scan_banner(self, r: _Replica) -> Optional[int]:
+        """The child's bound port from its stdout file (``--port 0``
+        binds an ephemeral port only the child knows)."""
+        try:
+            with open(r.out_path, "rb") as fh:
+                fh.seek(r.out_offset)
+                chunk = fh.read()
+        except OSError:
+            return None
+        # Only complete lines advance the offset; a torn tail is re-read
+        # whole on the next poll.
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return None
+        r.out_offset += end + 1
+        for line in chunk[:end].splitlines():
+            try:
+                doc = json.loads(line.decode("utf-8", "replace"))
+                if isinstance(doc, dict) and "serving" in doc:
+                    return int(doc["serving"]["port"])
+            except (ValueError, KeyError, TypeError):
+                continue  # not this child's banner; keep scanning
+        return None
+
+    def _probe(self, r: _Replica) -> Optional[dict]:
+        """One ``/healthz`` probe: the parsed body on HTTP 200, None on
+        anything else (503 warming/draining, connection refused, hung
+        socket) — "not routable right now", with the kill decision left
+        to the heartbeat/exit machinery."""
+        import urllib.error
+        import urllib.request
+
+        url = f"http://{self.host}:{r.port}/healthz"
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self.probe_timeout_s
+            ) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            e.close()
+            return None
+        except (OSError, ValueError):
+            return None
+
+    def _lose(self, r: _Replica, reason: str) -> None:
+        if r.proc is not None and r.proc.poll() is None:
+            _kill_tree(r.proc)
+        was_ready = r.ready
+        with self._lock:
+            r.proc = None
+            r.port = None
+            r.ready = False
+        r.was_lost = True
+        r.failures += 1
+        self._losses += 1
+        delay = min(self.backoff_cap_s,
+                    self.backoff_base_s * (2 ** (r.failures - 1)))
+        r.respawn_due = time.monotonic() + delay
+        obs.emit("fleet_replica_loss", replica=r.slot, reason=reason)
+        if was_ready:
+            self._write_roster("replica_loss")
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        for r in self._replicas.values():
+            if r.proc is None:
+                if now >= r.respawn_due and self._spawns - self.n \
+                        < self.max_respawns:
+                    self._spawn(r)
+                continue
+            rc = r.proc.poll()
+            if rc is not None:
+                self._lose(r, f"exit_{rc}")
+                continue
+            if r.port is None:
+                port = self._scan_banner(r)
+                if port is not None:
+                    r.port = port
+                elif now - r.spawned_t > self.spawn_timeout_s:
+                    self._lose(r, "startup_timeout")
+                continue
+            if r.mon is not None and r.mon.poll() == "stall":
+                # Process alive, heartbeat stale: a wedged replica (hung
+                # forward, stuck HTTP) — nothing softer than SIGKILL is
+                # guaranteed to land, same as a wedged mesh member.
+                self._lose(r, "stall")
+                continue
+            # Probe OFF the tick thread (one outstanding per replica):
+            # a wedged replica's probe blocks for the full probe
+            # timeout, and paying that serially here would delay loss
+            # detection and respawns for the whole fleet.
+            with self._lock:
+                launch = not r.probe_inflight
+                r.probe_inflight = launch
+            if launch:
+                threading.Thread(
+                    target=self._probe_update, args=(r,),
+                    name=f"fleet-probe-{r.slot}", daemon=True,
+                ).start()
+
+    def _probe_update(self, r: _Replica) -> None:
+        """One /healthz probe + state fold, on its own thread."""
+        try:
+            port = r.port
+            if port is None or r.proc is None:
+                return
+            health = self._probe(r)
+            if health is None:
+                # Not routable (warming, draining, or a transient probe
+                # failure): gate it out of the candidate set but leave
+                # the kill verdict to the heartbeat — probing through
+                # one dropped packet must not cost a respawn.
+                with self._lock:
+                    r.ready = False
+                return
+            with self._lock:
+                if r.port != port:  # lost/respawned while we probed
+                    return
+                r.queue_depth = int(health.get("queue_depth") or 0)
+                first_ready = not r.ready
+                r.ready = True
+                if first_ready:
+                    r.failures = 0
+                    if r.was_lost:
+                        self._rejoins += 1
+            if first_ready:
+                obs.emit("fleet_replica_ready", replica=r.slot,
+                         port=r.port)
+                self._write_roster(
+                    "replica_rejoin" if r.was_lost else "start"
+                )
+        finally:
+            with self._lock:
+                r.probe_inflight = False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception as e:  # supervision must outlive everything
+                # One bad spawn (fd exhaustion, ENOMEM — exactly
+                # incident conditions) must not silently kill the one
+                # thread whose job is respawning: log and keep polling.
+                obs.warn("fleet_tick_error", repr(e)[:300])
+            self._stop.wait(self.poll_s)
+
+    def _write_roster(self, reason: str) -> None:
+        """Mirror the ready set into ``membership.json`` — the elastic
+        trainer's document schema, reused as the fleet roster (an
+        operator mid-incident reads one file either way)."""
+        with self._lock:
+            members = tuple(sorted(
+                r.slot for r in self._replicas.values() if r.ready
+            ))
+            self._generation += 1
+            generation = self._generation
+        write_membership(self.run_dir, Membership(
+            generation=generation,
+            members=members,
+            min_world_size=1,
+            reason=reason,
+        ))
+
+    # -- the router's view ----------------------------------------------------
+    def candidates(self) -> list[Candidate]:
+        """Routable replicas, least-loaded first: ready (health-gated)
+        replicas scored by probed queue depth + the router's in-flight
+        count on that replica."""
+        with self._lock:
+            out = [
+                Candidate(r.slot, self.host, r.port,
+                          r.queue_depth + r.inflight)
+                for r in self._replicas.values()
+                if r.ready and r.port is not None
+            ]
+        return sorted(out, key=lambda c: (c.score, c.slot))
+
+    def note_inflight(self, slot: int, delta: int) -> None:
+        with self._lock:
+            r = self._replicas.get(slot)
+            if r is not None:
+                r.inflight = max(0, r.inflight + delta)
+
+    def note_failure(self, slot: int) -> None:
+        """A router-observed connection failure: gate the replica out of
+        the candidate set NOW (the supervision tick will confirm the
+        death and charge the loss within a poll)."""
+        with self._lock:
+            r = self._replicas.get(slot)
+            if r is not None:
+                r.ready = False
+
+    def kill_one(self) -> Optional[int]:
+        """SIGKILL one live replica (the ``replica_loss`` fault site's
+        arm): the HIGHEST live slot, mirroring the ``host_loss``
+        convention — slot 0's event stream stays the primary one."""
+        for r in sorted(self._replicas.values(),
+                        key=lambda x: -x.slot):
+            if r.proc is not None and r.proc.poll() is None:
+                _kill_tree(r.proc)
+                return r.slot
+        return None
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.ready)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": self.n,
+                "ready": sum(
+                    1 for r in self._replicas.values() if r.ready
+                ),
+                "spawns": self._spawns,
+                "losses": self._losses,
+                "rejoins": self._rejoins,
+                "ports": {
+                    r.slot: r.port for r in self._replicas.values()
+                    if r.port is not None
+                },
+            }

@@ -1,0 +1,392 @@
+"""The fleet router: one HTTP front end over N serving replicas.
+
+Routing policy, in the order a request experiences it:
+
+1. **Lane shed** — a ``batch``-lane request is forwarded only to a
+   replica whose load score sits under ``batch_shed_depth``; when every
+   healthy replica is above it, batch sheds IMMEDIATELY (fleet 503 +
+   ``Retry-After``, a ``fleet_shed`` event) while interactive traffic
+   still gets the full spillover walk. Same shed order as the batcher's
+   per-lane admission caps, one level up.
+2. **Health-gated least-queue-depth pick** — candidates come from the
+   replica manager's ``/healthz``-fed view (ready replicas scored by
+   probed queue depth + router in-flight), least-loaded first.
+3. **Spillover** — a replica's overload/draining 503 means "try the
+   next healthy replica" (``fleet_spillover``, trace id preserved via
+   ``X-Featurenet-Trace``); the fleet-wide 503 with ``Retry-After``
+   answers only when every lane is full.
+4. **Re-submit once** — a connection that dies mid-request (the replica
+   was SIGKILLed under us) re-submits the request to ONE survivor
+   (``fleet_resubmit``; idempotent — classification is pure). A second
+   connection death is an honest drop (502, counted in
+   ``fleet_requests_dropped`` — the number the gate pins at 0).
+
+Scaling verdicts are advisory, never load-bearing: the router feeds its
+end-to-end walls into the rolling ``serving_ms`` window (the SAME alert
+machinery every service runs) and a background cycle turns the window
+p99 + roster queue depths into ``fleet_scale{verdict: add|shed|hold}``
+events — what an autoscaler would subscribe to; nothing in the routing
+path reads them back.
+
+Stdlib + numpy-free by contract (``analysis.rules.HOT_PATH_MODULES``):
+the router process owns no device and must survive every replica.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from featurenet_tpu import faults, obs
+from featurenet_tpu.obs import alerts as _alerts
+from featurenet_tpu.obs import windows as _windows
+from featurenet_tpu.obs.tracing import TRACE_HEADER, normalize_trace_id
+from featurenet_tpu.serve.batcher import normalize_lane
+from featurenet_tpu.serve.http import PRIORITY_HEADER
+from featurenet_tpu.serve.service import DEFAULT_SLO_P99_MS, serve_rules
+
+DEFAULT_BATCH_SHED_DEPTH = 8
+DEFAULT_RETRY_AFTER_S = 0.25
+DEFAULT_SCALE_EVERY_S = 5.0
+
+_ENDPOINTS = ["POST /predict", "POST /predict_voxels", "GET /stats",
+              "GET /healthz"]
+
+# Queue depth (mean over ready replicas) above which the scale verdict
+# says "add" even while the p99 still holds — pressure building is the
+# earlier signal.
+_SCALE_ADD_DEPTH = 8.0
+
+
+def post_once(host: str, port: int, path: str, body: bytes,
+              headers: dict, timeout_s: float):
+    """One HTTP POST hop (the router's forward AND the fleet load
+    generator's request — one implementation, so Retry-After parsing
+    and header handling can never drift between the two). Returns
+    ``(status, body_bytes, retry_after_s)``; connection-level failures
+    raise ``OSError`` / ``http.client.HTTPException`` upward."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("POST", path, body=body, headers={
+            "Content-Type": "application/octet-stream",
+            **headers,
+        })
+        resp = conn.getresponse()
+        data = resp.read()
+        ra = resp.getheader("Retry-After")
+        try:
+            ra = float(ra) if ra is not None else None
+        except ValueError:
+            ra = None
+        return resp.status, data, ra
+    finally:
+        conn.close()
+
+
+def scale_verdict(p99_ms: Optional[float], queue_depth: float,
+                  ready: int,
+                  slo_p99_ms: float = DEFAULT_SLO_P99_MS) -> str:
+    """The advisory verdict from one observation cycle: ``add`` when the
+    SLO is breached (or no replica is routable, or queues are building),
+    ``shed`` when the fleet is provably oversized (well under SLO, idle
+    queues, more than one replica), else ``hold``. Pure — unit-testable
+    without a fleet."""
+    if ready == 0:
+        return "add"
+    if p99_ms is not None and p99_ms > slo_p99_ms:
+        return "add"
+    if queue_depth > _SCALE_ADD_DEPTH:
+        return "add"
+    if ready > 1 and queue_depth <= 0.5 and (
+        p99_ms is None or p99_ms < 0.25 * slo_p99_ms
+    ):
+        return "shed"
+    return "hold"
+
+
+class FleetRouter:
+    """Route requests over a replica provider (``ReplicaManager`` in
+    production; anything with ``candidates()`` / ``note_inflight`` /
+    ``note_failure`` / ``kill_one`` in tests)."""
+
+    def __init__(self, fleet, *,
+                 slo_p99_ms: float = DEFAULT_SLO_P99_MS,
+                 rules: Optional[Sequence] = None,
+                 batch_shed_depth: int = DEFAULT_BATCH_SHED_DEPTH,
+                 retry_after_s: float = DEFAULT_RETRY_AFTER_S,
+                 request_timeout_s: float = 60.0,
+                 scale_every_s: float = DEFAULT_SCALE_EVERY_S):
+        self.fleet = fleet
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.batch_shed_depth = int(batch_shed_depth)
+        self.retry_after_s = float(retry_after_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.scale_every_s = float(scale_every_s)
+        self._lock = threading.Lock()
+        self._routed = 0
+        self._answered = 0
+        self._rejected = 0
+        self._shed = 0
+        self._spillovers = 0
+        self._resubmits = 0
+        self._dropped = 0
+        self._draining = False
+        self._stopped = False
+        # The same rolling-window/alert machinery every InferenceService
+        # installs — here it watches the ROUTER's end-to-end walls, so
+        # the drain gate and the scale verdicts read fleet-level latency.
+        if rules is None:
+            rules = serve_rules(slo_p99_ms)
+        if rules:
+            _windows.install(_windows.WindowAggregator(rules=list(rules)))
+        self._last_verdict: Optional[str] = None
+        self._scale_stop = threading.Event()
+        self._scale_thread = threading.Thread(
+            target=self._scale_loop, name="fleet-scale", daemon=True
+        )
+        self._scale_thread.start()
+
+    # -- scaling verdicts (advisory) ------------------------------------------
+    def _scale_tick(self) -> None:
+        cands = self.fleet.candidates()
+        depth = (sum(c.score for c in cands) / len(cands)) if cands \
+            else 0.0
+        p99 = (_windows.snapshot().get("serving_ms") or {}).get("p99")
+        verdict = scale_verdict(p99, depth, len(cands), self.slo_p99_ms)
+        if verdict != self._last_verdict:
+            self._last_verdict = verdict
+            obs.emit("fleet_scale", verdict=verdict,
+                     p99_ms=round(p99, 3) if p99 is not None else None,
+                     queue_depth=round(depth, 2), replicas=len(cands))
+
+    def _scale_loop(self) -> None:
+        while not self._scale_stop.wait(self.scale_every_s):
+            self._scale_tick()
+
+    # -- the routing core -----------------------------------------------------
+    def _forward(self, cand, path: str, body: bytes, trace_id: str,
+                 lane: str):
+        """One hop to one replica. Returns ``(status, body_bytes,
+        retry_after_s)``; raises ``OSError`` / ``HTTPException`` when
+        the connection dies (the replica-loss shape)."""
+        return post_once(
+            cand.host, cand.port, path, body,
+            {TRACE_HEADER: trace_id, PRIORITY_HEADER: lane},
+            self.request_timeout_s,
+        )
+
+    def route(self, path: str, body: bytes,
+              trace_id: Optional[str] = None,
+              lane: str = "interactive") -> tuple[int, bytes, dict]:
+        """Route one request; returns ``(status, body_bytes, headers)``
+        with the trace echo and any ``Retry-After`` in ``headers``."""
+        lane = normalize_lane(lane)
+        trace_id = normalize_trace_id(trace_id)
+        headers = {TRACE_HEADER: trace_id}
+        with self._lock:
+            if self._draining:
+                headers["Retry-After"] = f"{self.retry_after_s:.3f}"
+                return 503, json.dumps(
+                    {"error": "draining", "fleet": True}
+                ).encode(), headers
+            self._routed += 1
+            routed = self._routed
+        if faults.maybe_fail("replica_loss", request=routed):
+            # The chaos arm: SIGKILL a live replica mid-stream — the
+            # in-flight requests riding it are exactly what the
+            # re-submit path below must absorb.
+            self.fleet.kill_one()
+        t0 = time.perf_counter()
+        tried: set = set()
+        failed_once = False
+        retry_hint = None
+        while True:
+            cands = [c for c in self.fleet.candidates()
+                     if c.slot not in tried]
+            if lane == "batch" and cands:
+                under = [c for c in cands
+                         if c.score < self.batch_shed_depth]
+                if not under and not failed_once:
+                    # Shed batch first: every healthy replica is above
+                    # the batch-pressure bar — don't even occupy one.
+                    # A request that already DIED on a replica is NOT
+                    # shed-able (it may have been admitted there): empty
+                    # the candidate walk instead, so the exhaustion
+                    # branch below counts it as the drop it is.
+                    with self._lock:
+                        self._shed += 1
+                    obs.emit("fleet_shed", lane=lane,
+                             queue_depth=min(c.score for c in cands))
+                    headers["Retry-After"] = f"{self.retry_after_s:.3f}"
+                    return 503, json.dumps({
+                        "error": "overload", "fleet": True,
+                        "lane": lane, "shed": True,
+                        "retry_after_s": self.retry_after_s,
+                    }).encode(), headers
+                cands = under
+            if not cands:
+                # Every lane is full (or every replica tried): the
+                # fleet-wide verdict. A request that already DIED on a
+                # replica once may have been admitted there — that is a
+                # drop, not a clean rejection.
+                ra = retry_hint if retry_hint is not None \
+                    else self.retry_after_s
+                headers["Retry-After"] = f"{ra:.3f}"
+                if failed_once:
+                    with self._lock:
+                        self._dropped += 1
+                    return 502, json.dumps({
+                        "error": "replica_lost", "fleet": True,
+                        "detail": "no surviving replica to re-submit to",
+                    }).encode(), headers
+                with self._lock:
+                    self._rejected += 1
+                return 503, json.dumps({
+                    "error": "overload", "fleet": True, "lane": lane,
+                    "retry_after_s": ra,
+                }).encode(), headers
+            cand = cands[0]
+            tried.add(cand.slot)
+            self.fleet.note_inflight(cand.slot, 1)
+            try:
+                status, data, ra = self._forward(
+                    cand, path, body, trace_id, lane
+                )
+            except (OSError, http.client.HTTPException):
+                self.fleet.note_failure(cand.slot)
+                if failed_once:
+                    # Re-submit ONCE: a second replica dying under the
+                    # same request is an honest drop, not a retry loop.
+                    with self._lock:
+                        self._dropped += 1
+                    return 502, json.dumps({
+                        "error": "replica_lost", "fleet": True,
+                        "replica": cand.slot,
+                    }).encode(), headers
+                failed_once = True
+                with self._lock:
+                    self._resubmits += 1
+                obs.emit("fleet_resubmit", trace=trace_id,
+                         from_replica=cand.slot)
+                continue
+            finally:
+                self.fleet.note_inflight(cand.slot, -1)
+            if status == 503:
+                # Replica-level overload/draining: spill to the next
+                # healthy replica, trace id preserved. The replica's
+                # Retry-After rides along in case the WALK ends 503.
+                retry_hint = ra if ra is not None else retry_hint
+                with self._lock:
+                    self._spillovers += 1
+                obs.emit("fleet_spillover", trace=trace_id,
+                         from_replica=cand.slot)
+                continue
+            if status == 200:
+                with self._lock:
+                    self._answered += 1
+                # The fleet-level end-to-end wall (client admission →
+                # replica response through every spill/re-submit hop):
+                # what the serving SLO means at the fleet boundary.
+                _windows.observe(
+                    "serving_ms", (time.perf_counter() - t0) * 1e3
+                )
+            return status, data, headers
+
+    # -- HTTP front end -------------------------------------------------------
+    def make_server(self, host: str = "127.0.0.1",
+                    port: int = 0) -> ThreadingHTTPServer:
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      headers: dict) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers.items():
+                    if v is not None:
+                        self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    ready = router.fleet.ready_count() > 0 \
+                        and not router._draining
+                    body = json.dumps({
+                        "ready": ready, "fleet": True,
+                        **router.fleet.stats(),
+                    }).encode()
+                    self._send(200 if ready else 503, body, {})
+                    return
+                if self.path == "/stats":
+                    body = json.dumps(
+                        {"ok": True, **router.stats()}
+                    ).encode()
+                    self._send(200, body, {})
+                    return
+                self._send(404, json.dumps({
+                    "error": "not_found", "endpoints": _ENDPOINTS,
+                }).encode(), {})
+
+            def do_POST(self):  # noqa: N802
+                if self.path not in ("/predict", "/predict_voxels"):
+                    self._send(404, json.dumps({
+                        "error": "not_found", "endpoints": _ENDPOINTS,
+                    }).encode(), {})
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                status, data, headers = router.route(
+                    self.path, body,
+                    trace_id=self.headers.get(TRACE_HEADER),
+                    lane=self.headers.get(PRIORITY_HEADER),
+                )
+                self._send(status, data, headers)
+
+        srv = ThreadingHTTPServer((host, port), Handler)
+        srv.daemon_threads = True
+        return srv
+
+    # -- introspection / lifecycle --------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "routed": self._routed,
+                "answered": self._answered,
+                "rejected": self._rejected,
+                "shed": self._shed,
+                "spillovers": self._spillovers,
+                "resubmits": self._resubmits,
+                "dropped": self._dropped,
+                "replicas": self.fleet.stats(),
+            }
+
+    def drain(self) -> dict:
+        """Stop routing, flush the final window cycle, report the fleet
+        verdict: ``exit_code`` 2 when a serving alert is unresolved OR
+        any admitted request was dropped — the fleet's whole promise."""
+        with self._lock:
+            self._draining = True
+            first = not self._stopped
+            self._stopped = True
+        self._scale_stop.set()
+        self._scale_thread.join(timeout=2.0)
+        _windows.flush()
+        st = self.stats()
+        active = [m for m in _windows.active_alerts()
+                  if _alerts.is_serving_metric(m)]
+        st["active_serving_alerts"] = active
+        st["exit_code"] = 2 if (active or st["dropped"]) else 0
+        if first:
+            obs.emit("fleet_stop", routed=st["routed"],
+                     answered=st["answered"], rejected=st["rejected"],
+                     dropped=st["dropped"])
+        return st

@@ -141,6 +141,15 @@ DIRECTIONS = {
     "scaling_sps_per_chip_32x": "min",
     "scaling_sps_per_chip_64x": "min",
     "scaling_efficiency": "min",
+    # Serving fleet (featurenet_tpu.fleet, bench_fleet's row measured
+    # THROUGH a mid-run replica kill): sustained router-level QPS
+    # regresses downward, the fleet p99 upward, and dropped admitted
+    # requests are pinned at a baseline of ZERO with no slack — the
+    # whole point of the re-submit path is that replica loss never
+    # loses admitted work.
+    "fleet_qps_sustained": "min",
+    "fleet_p99_ms": "max",
+    "fleet_requests_dropped": "max",
 }
 
 
@@ -190,6 +199,11 @@ def report_gate_values(rep: dict) -> dict[str, float]:
     ]
     if train_peaks:
         vals["hbm_peak_train_bytes"] = float(max(train_peaks))
+    # Serving fleet: the drained drop count is gateable from a run
+    # report too — a fleet run dir judges its own zero-drop promise.
+    fleet = rep.get("fleet") or {}
+    if isinstance(fleet.get("dropped"), (int, float)):
+        vals["fleet_requests_dropped"] = float(fleet["dropped"])
     vals["bad_lines"] = float(rep.get("bad_lines", 0))
     return vals
 
@@ -253,6 +267,9 @@ BENCH_GATE_KEYS = (
     "scaling_sps_per_chip_64x",
     "scaling_efficiency",
     "data_wait_spread",
+    "fleet_qps_sustained",
+    "fleet_p99_ms",
+    "fleet_requests_dropped",
 )
 
 

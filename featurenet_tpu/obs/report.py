@@ -810,6 +810,55 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             serve["rejected"] = stops[-1].get("rejected")
         rep["serve"] = serve
 
+    # --- serving fleet (featurenet_tpu.fleet) --------------------------------
+    # Roster transitions + routing outcomes, merged across every stream
+    # (the router owns stream 0; each replica writes its own). The
+    # timeline is the mesh_reform-style roster history: who was lost
+    # why, and when each respawn turned ready again.
+    fl = [e for e in events
+          if isinstance(e.get("ev"), str) and e["ev"].startswith("fleet_")]
+    if fl:
+        starts = [e for e in fl if e["ev"] == "fleet_start"]
+        stops = [e for e in fl if e["ev"] == "fleet_stop"]
+        sheds: dict[str, int] = {}
+        for e in fl:
+            if e["ev"] == "fleet_shed":
+                lane = str(e.get("lane", "?"))
+                sheds[lane] = sheds.get(lane, 0) + 1
+        verdicts: dict[str, int] = {}
+        for e in fl:
+            if e["ev"] == "fleet_scale":
+                v = str(e.get("verdict", "?"))
+                verdicts[v] = verdicts.get(v, 0) + 1
+        fleet: dict = {
+            "replicas": starts[-1].get("replicas") if starts else None,
+            "ready_events": sum(
+                e["ev"] == "fleet_replica_ready" for e in fl
+            ),
+            "losses": sum(
+                e["ev"] == "fleet_replica_loss" for e in fl
+            ),
+            "spillovers": sum(e["ev"] == "fleet_spillover" for e in fl),
+            "resubmits": sum(e["ev"] == "fleet_resubmit" for e in fl),
+            "sheds": sheds,
+            "scale_verdicts": verdicts,
+            "timeline": [
+                {"t": round(e["t"], 3), "event": e["ev"],
+                 **{k: v for k, v in e.items()
+                    if k not in ("t", "ev", "pid", "process_index")}}
+                for e in fl
+                if e["ev"] in ("fleet_start", "fleet_replica_ready",
+                               "fleet_replica_loss", "fleet_scale",
+                               "fleet_stop")
+            ],
+        }
+        if stops:
+            fleet["routed"] = stops[-1].get("routed")
+            fleet["answered"] = stops[-1].get("answered")
+            fleet["rejected"] = stops[-1].get("rejected")
+            fleet["dropped"] = stops[-1].get("dropped")
+        rep["fleet"] = fleet
+
     # --- request-level traces (obs.tracing) ----------------------------------
     ts_rate = ((manifest or {}).get("config") or {}).get("trace_sample")
     traces = _traces_section(
@@ -1105,6 +1154,33 @@ def format_report(rep: dict) -> str:
                     f"{k}×{v}" for k, v in se["by_bucket"].items()
                 )
             )
+    fl = rep.get("fleet")
+    if fl:
+        lines.append(
+            f"fleet: {fl.get('replicas')} replica(s); "
+            f"{fl['losses']} loss(es), {fl['ready_events']} ready "
+            f"event(s), {fl['spillovers']} spillover(s), "
+            f"{fl['resubmits']} re-submit(s)"
+            + (", sheds " + ", ".join(
+                f"{k}×{v}" for k, v in fl["sheds"].items()
+               ) if fl.get("sheds") else "")
+            + (f"; drained routed={fl['routed']} "
+               f"answered={fl.get('answered')} "
+               f"rejected={fl.get('rejected')} dropped={fl['dropped']}"
+               if fl.get("routed") is not None else "")
+        )
+        if fl.get("scale_verdicts"):
+            lines.append(
+                "  scale verdicts: " + ", ".join(
+                    f"{k}×{v}" for k, v in sorted(
+                        fl["scale_verdicts"].items()
+                    )
+                ) + " (advisory)"
+            )
+        for e in fl.get("timeline", ()):
+            detail = {k: v for k, v in e.items()
+                      if k not in ("t", "event")}
+            lines.append(f"  t={e['t']:.3f} {e['event']} {detail or ''}")
     tr = rep.get("traces")
     if tr:
         lines.append(
@@ -1378,6 +1454,18 @@ KNOWN_EVENT_KINDS = frozenset({
     # CALLER observed (client p50/p99 vs the server's serving_ms windows
     # — the skew between them is real queueing, measured on one clock).
     "loadgen",
+    # Serving fleet (featurenet_tpu.fleet): the router came up over N
+    # replicas; a replica turned ready (first warmup or a respawn
+    # rejoining the roster) / was charged lost (death, stall, startup
+    # timeout); one overloaded replica's request spilled to the next
+    # healthy one; one in-flight request was re-submitted to a survivor
+    # after its replica died under it; a batch-lane request was shed at
+    # the router; an advisory scaling verdict changed; the router's
+    # drain record (routed / answered / rejected / dropped — dropped is
+    # the gate-pinned zero).
+    "fleet_start", "fleet_replica_ready", "fleet_replica_loss",
+    "fleet_spillover", "fleet_resubmit", "fleet_shed", "fleet_scale",
+    "fleet_stop",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -1415,6 +1503,14 @@ REQUIRED_EVENT_FIELDS = {
                      "outcome"),
     "request_reject": ("trace", "queue_depth", "limit"),
     "loadgen": ("n", "client_p50_ms", "client_p99_ms"),
+    "fleet_start": ("replicas",),
+    "fleet_replica_ready": ("replica",),
+    "fleet_replica_loss": ("replica", "reason"),
+    "fleet_spillover": ("trace", "from_replica"),
+    "fleet_resubmit": ("trace", "from_replica"),
+    "fleet_shed": ("lane",),
+    "fleet_scale": ("verdict",),
+    "fleet_stop": ("routed", "dropped"),
 }
 
 # The event kinds that carry a per-request ``trace`` id — the timeline

@@ -26,9 +26,15 @@ Scheduling contract:
   callers.
 - **Admission control**: the queue is bounded. At the bound, ``submit``
   fast-rejects with ``OverloadError`` (a structured ``response`` dict for
-  the HTTP layer, an ``overload`` event for the run log) instead of
-  letting latency grow without bound — under overload the operator wants
-  rejections they can count, not a queue they cannot see the end of.
+  the HTTP layer — carrying the server's ``retry_after_s`` backoff hint —
+  and an ``overload`` event for the run log) instead of letting latency
+  grow without bound — under overload the operator wants rejections they
+  can count, not a queue they cannot see the end of.
+- **Priority lanes** (``LANES``): every request rides a lane
+  (``interactive`` default, ``batch`` for deferrable bulk). Per-lane
+  queue caps (``lane_limits``) trip before the global bound, so under
+  pressure ``batch`` sheds FIRST and interactive keeps its headroom —
+  the fleet router applies the same shed order one level up.
 
 Telemetry (never load-bearing, like the rest of the obs layer): each
 request feeds ``queue_wait_ms`` (enqueue → dispatch) and ``serving_ms``
@@ -62,15 +68,32 @@ DEFAULT_BUCKETS = (1, 4, 16, 64)
 DEFAULT_MAX_WAIT_MS = 5.0
 DEFAULT_QUEUE_LIMIT = 64
 
+# Request-priority lanes. "interactive" is the default (a human is
+# waiting); "batch" is deferrable bulk traffic — a per-lane queue limit
+# caps how much of the admission bound it may occupy, so under pressure
+# batch sheds FIRST and interactive keeps its headroom. Unknown lane
+# strings normalize to "interactive": a misspelled priority must degrade
+# to the stricter admission, never to silent bulk treatment.
+LANES = ("interactive", "batch")
+
+
+def normalize_lane(lane: Optional[str]) -> str:
+    return lane if lane in LANES else "interactive"
+
 
 class OverloadError(RuntimeError):
-    """Fast rejection at the admission bound: the queue is full, and the
-    honest answer is an immediate structured "try later" — not an
-    unbounded wait. ``response`` is the wire shape the HTTP front end
-    returns with a 503."""
+    """Fast rejection at the admission bound: the queue is full (or this
+    request's priority lane is), and the honest answer is an immediate
+    structured "try later" — not an unbounded wait. ``response`` is the
+    wire shape the HTTP front end returns with a 503; ``retry_after_s``
+    is the server's honest backoff hint (the queue turns over on the
+    flush-deadline cadence), surfaced as the HTTP ``Retry-After``
+    header and honored by the load generator and the fleet router."""
 
     def __init__(self, queue_depth: int, limit: int,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 lane: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
         super().__init__(f"serving queue full ({queue_depth}/{limit})")
         self.queue_depth = int(queue_depth)
         self.limit = int(limit)
@@ -79,14 +102,21 @@ class OverloadError(RuntimeError):
         # wire `response` shape is unchanged — load balancers key off
         # structure that predates tracing).
         self.trace_id = trace_id
+        self.lane = lane
+        self.retry_after_s = retry_after_s
 
     @property
     def response(self) -> dict:
-        return {
+        out = {
             "error": "overload",
             "queue_depth": self.queue_depth,
             "limit": self.limit,
         }
+        if self.lane is not None:
+            out["lane"] = self.lane
+        if self.retry_after_s is not None:
+            out["retry_after_s"] = round(self.retry_after_s, 3)
+        return out
 
 
 def normalize_buckets(buckets: Sequence[int]) -> tuple[int, ...]:
@@ -115,11 +145,13 @@ class PendingRequest:
     request's own output row (or the batch's forward error)."""
 
     __slots__ = ("voxels", "t_enq", "t_done", "value", "error", "_event",
-                 "ctx")
+                 "ctx", "lane")
 
     def __init__(self, voxels: np.ndarray,
-                 ctx: Optional[_tracing.TraceContext] = None):
+                 ctx: Optional[_tracing.TraceContext] = None,
+                 lane: str = "interactive"):
         self.voxels = voxels
+        self.lane = lane
         self.t_enq = time.perf_counter()
         self.t_done: Optional[float] = None
         self.value = None
@@ -172,12 +204,23 @@ class ContinuousBatcher:
                  cost_for: Optional[Callable] = None,
                  peaks: Optional[dict] = None,
                  trace_sample: float = 1.0,
-                 trace_slo_ms: Optional[float] = None):
+                 trace_slo_ms: Optional[float] = None,
+                 lane_limits: Optional[dict] = None):
         bs = normalize_buckets(buckets)
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        for lane, lim in (lane_limits or {}).items():
+            if lane not in LANES:
+                raise ValueError(
+                    f"unknown lane {lane!r} in lane_limits; "
+                    f"known lanes: {', '.join(LANES)}"
+                )
+            if lim < 0:
+                raise ValueError(
+                    f"lane_limits[{lane!r}] must be >= 0, got {lim}"
+                )
         self.forward = forward
         # Performance attribution (obs.perf), injected to keep the batcher
         # backend-free: ``cost_for(bucket)`` returns that bucket's
@@ -201,8 +244,18 @@ class ContinuousBatcher:
         self.buckets = bs
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.queue_limit = int(queue_limit)
+        # Per-lane admission caps ({"batch": N}): a lane at its cap
+        # rejects even while the global queue has room — the shed-first
+        # discipline that keeps interactive headroom under pressure.
+        self.lane_limits = dict(lane_limits or {})
+        # The Retry-After hint on a rejection: the queue turns over on
+        # the flush-deadline cadence, so "come back after ~2 deadlines"
+        # is the honest earliest time a retry could find room.
+        self.retry_after_s = max(0.05, 2.0 * self.max_wait_s)
         self._cv = threading.Condition()
         self._queue: deque[PendingRequest] = deque()
+        self._lane_depth: dict[str, int] = {}
+        self._lane_rejected: dict[str, int] = {}
         self._draining = False
         self._stopped = False
         self._served = 0
@@ -224,13 +277,17 @@ class ContinuousBatcher:
 
     # -- producer side -------------------------------------------------------
     def submit(self, voxels: np.ndarray,
-               trace_id: Optional[str] = None) -> PendingRequest:
+               trace_id: Optional[str] = None,
+               lane: str = "interactive") -> PendingRequest:
         """Enqueue one request; returns its future. Raises
-        ``OverloadError`` immediately at the queue bound and
-        ``RuntimeError`` after ``drain()``. ``trace_id`` adopts a
-        caller-supplied trace id (the HTTP propagation header); None
-        mints one — either way the id rides the returned future."""
-        p = PendingRequest(voxels)
+        ``OverloadError`` immediately at the queue bound — or at the
+        request's LANE bound (``lane_limits``), which trips first for
+        ``batch`` traffic under pressure — and ``RuntimeError`` after
+        ``drain()``. ``trace_id`` adopts a caller-supplied trace id (the
+        HTTP propagation header); None mints one — either way the id
+        rides the returned future."""
+        lane = normalize_lane(lane)
+        p = PendingRequest(voxels, lane=lane)
         with self._cv:
             if self._draining:
                 raise RuntimeError(
@@ -242,21 +299,30 @@ class ContinuousBatcher:
             # lock across: a counter bump, a clock read, 8 random bytes.
             ctx = p.ctx = _tracing.admit(trace_id, self.trace_sample)
             depth = len(self._queue)
-            if depth >= self.queue_limit:
+            lane_cap = self.lane_limits.get(lane)
+            if depth >= self.queue_limit or (
+                lane_cap is not None
+                and self._lane_depth.get(lane, 0) >= lane_cap
+            ):
                 self._rejected += 1
+                self._lane_rejected[lane] = \
+                    self._lane_rejected.get(lane, 0) + 1
             else:
                 self._queue.append(p)
+                self._lane_depth[lane] = self._lane_depth.get(lane, 0) + 1
                 self._cv.notify_all()
                 depth = -1
         if depth >= 0:
             # Emit outside the lock: the sink has its own, and a slow
             # filesystem must not extend the admission critical section.
-            obs.emit("overload", queue_depth=depth, limit=self.queue_limit)
+            obs.emit("overload", queue_depth=depth, limit=self.queue_limit,
+                     lane=lane)
             # Rejections are always sampled (tail bias): the structured
             # trace is exactly what the operator chases after a 503.
             _tracing.reject(ctx, depth, self.queue_limit)
             raise OverloadError(depth, self.queue_limit,
-                                trace_id=ctx.trace_id)
+                                trace_id=ctx.trace_id, lane=lane,
+                                retry_after_s=self.retry_after_s)
         return p
 
     # -- dispatcher thread ---------------------------------------------------
@@ -299,7 +365,10 @@ class ContinuousBatcher:
                 full = [b for b in self.buckets if b <= k]
                 if full:
                     k = full[-1]
-            return [self._queue.popleft() for _ in range(k)]
+            batch = [self._queue.popleft() for _ in range(k)]
+            for p in batch:
+                self._lane_depth[p.lane] = self._lane_depth[p.lane] - 1
+            return batch
 
     def _dispatch(self, batch: list[PendingRequest]) -> None:
         n = len(batch)
@@ -381,6 +450,19 @@ class ContinuousBatcher:
                 "occupancy": round(self._rows / cap, 4) if cap else None,
                 "by_bucket": dict(sorted(self._by_bucket.items())),
                 "queue_depth": len(self._queue),
+                # Priority lanes: what is queued and what was shed, per
+                # lane — the shed-order evidence (batch rejects first).
+                "by_lane": {
+                    lane: {
+                        "queued": self._lane_depth.get(lane, 0),
+                        "rejected": self._lane_rejected.get(lane, 0),
+                        "limit": self.lane_limits.get(lane),
+                    }
+                    for lane in LANES
+                    if self._lane_depth.get(lane, 0)
+                    or self._lane_rejected.get(lane, 0)
+                    or lane in self.lane_limits
+                },
             }
 
     def drain(self, timeout_s: float = 30.0) -> dict:

@@ -8,9 +8,18 @@ Status codes carry the admission contract:
 - ``200`` — answered; body is ``InferenceService.format_row`` output.
 - ``400`` — unparseable STL; the body names the parse failure.
 - ``503`` — overload fast-reject; body is ``OverloadError.response``
-  (``{"error": "overload", "queue_depth": ..., "limit": ...}``) so a
-  load balancer can back off on structure, not on string-matching.
+  (``{"error": "overload", "queue_depth": ..., "limit": ...,
+  "lane": ..., "retry_after_s": ...}`` plus ``"replica"`` when the
+  service has a fleet identity) with a ``Retry-After`` header carrying
+  the same backoff hint, so a load balancer can back off on structure,
+  not on string-matching.
 - ``504`` — admitted but not answered within the handler timeout.
+
+``POST /predict_voxels`` is the pre-voxelized sibling: raw float32
+little-endian bytes of one ``[R,R,R]`` occupancy grid (no geometry work
+server-side — the fleet load generator's path). Both POST endpoints read
+the ``X-Featurenet-Priority`` header (``interactive`` default /
+``batch``): batch rides the shed-first lane of the batcher's admission.
 
 Trace propagation: a caller-supplied ``X-Featurenet-Trace`` request
 header is adopted as the request's trace id (``obs.tracing``) and echoed
@@ -42,12 +51,34 @@ import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from featurenet_tpu.obs.tracing import TRACE_HEADER, normalize_trace_id
-from featurenet_tpu.serve.batcher import OverloadError
+from featurenet_tpu.serve.batcher import OverloadError, normalize_lane
 
 DEFAULT_REQUEST_TIMEOUT_S = 60.0
 
-_ENDPOINTS = ["POST /predict", "GET /stats", "GET /healthz",
-              "GET /metrics"]
+# Request-priority header: "interactive" (default) or "batch". Unknown
+# values normalize to interactive (the stricter admission) — a typo'd
+# priority must never be treated as shed-first bulk.
+PRIORITY_HEADER = "X-Featurenet-Priority"
+
+_ENDPOINTS = ["POST /predict", "POST /predict_voxels", "GET /stats",
+              "GET /healthz", "GET /metrics"]
+
+
+def _parse_voxels(data: bytes, resolution: int):
+    """One ``[R,R,R]`` float32 occupancy grid from raw little-endian
+    bytes (the ``/predict_voxels`` wire shape). Size-checked before the
+    reshape so a short body is a 400, not a numpy traceback."""
+    import numpy as np
+
+    want = resolution ** 3 * 4
+    if len(data) != want:
+        raise ValueError(
+            f"expected {want} bytes (float32 [{resolution}]^3 grid), "
+            f"got {len(data)}"
+        )
+    return np.frombuffer(data, dtype="<f4").reshape(
+        (resolution,) * 3
+    )
 
 
 def make_server(service, host: str = "127.0.0.1", port: int = 0,
@@ -63,7 +94,8 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
             pass  # access logging is the obs layer's job, not stderr's
 
         def _json(self, code: int, payload: dict,
-                  trace_id: str | None = None) -> None:
+                  trace_id: str | None = None,
+                  retry_after_s: float | None = None) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -74,8 +106,22 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                 # outcome, so the caller can correlate and a router
                 # can follow the hop.
                 self.send_header(TRACE_HEADER, trace_id)
+            if retry_after_s is not None:
+                # Decimal seconds (our clients — loadgen and the fleet
+                # router — parse float; integer-only parsers read the
+                # leading digits, still a sane backoff).
+                self.send_header("Retry-After", f"{retry_after_s:.3f}")
             self.end_headers()
             self.wfile.write(body)
+
+        def _reject_body(self, payload: dict) -> dict:
+            # Every rejection body names the replica when the service
+            # has a fleet identity — the router (and a client holding a
+            # 503) can then say WHICH backend refused, not just "one
+            # did".
+            if getattr(service, "replica", None) is not None:
+                return {**payload, "replica": service.replica}
+            return payload
 
         def do_GET(self):  # noqa: N802 (stdlib name)
             if self.path == "/stats":
@@ -105,7 +151,7 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                              "endpoints": _ENDPOINTS})
 
         def do_POST(self):  # noqa: N802 (stdlib name)
-            if self.path != "/predict":
+            if self.path not in ("/predict", "/predict_voxels"):
                 self._json(404, {"error": "not_found",
                                  "endpoints": _ENDPOINTS})
                 return
@@ -114,15 +160,31 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
             trace_id = normalize_trace_id(
                 self.headers.get(TRACE_HEADER)
             )
+            lane = normalize_lane(self.headers.get(PRIORITY_HEADER))
             length = int(self.headers.get("Content-Length") or 0)
             data = self.rfile.read(length)
             try:
-                fut = service.submit_stl_bytes(data, trace_id=trace_id)
+                if self.path == "/predict_voxels":
+                    # The pre-voxelized fast path (fleet loadgen, a
+                    # router fronting voxel-native clients): raw float32
+                    # little-endian bytes of one [R,R,R] occupancy grid.
+                    fut = service.submit_voxels(
+                        _parse_voxels(data, service.cfg.resolution),
+                        trace_id=trace_id, lane=lane,
+                    )
+                else:
+                    fut = service.submit_stl_bytes(
+                        data, trace_id=trace_id, lane=lane
+                    )
             except OverloadError as e:
-                self._json(503, e.response, trace_id=e.trace_id)
+                self._json(503, self._reject_body(e.response),
+                           trace_id=e.trace_id,
+                           retry_after_s=e.retry_after_s)
                 return
             except ValueError as e:
-                self._json(400, {"error": "bad_stl", "detail": str(e)},
+                self._json(400, {"error": "bad_stl"
+                                 if self.path == "/predict"
+                                 else "bad_voxels", "detail": str(e)},
                            trace_id=trace_id)
                 return
             except RuntimeError as e:
@@ -131,8 +193,10 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                 # answer it structurally like any other rejection, not
                 # with a dropped socket. (OverloadError is a
                 # RuntimeError; its clause above must come first.)
-                self._json(503, {"error": "draining", "detail": str(e)},
-                           trace_id=trace_id)
+                self._json(503, self._reject_body(
+                    {"error": "draining", "detail": str(e)}
+                ), trace_id=trace_id,
+                    retry_after_s=service.batcher.retry_after_s)
                 return
             try:
                 row = fut.result(timeout=request_timeout_s)

@@ -46,14 +46,22 @@ BENCH_QPS_CAP = 8000.0
 def poisson_load(service, qps: float, n_requests: int,
                  rng: Optional[np.random.Generator] = None,
                  grids: Optional[np.ndarray] = None,
-                 timeout_s: float = 120.0) -> tuple[dict, list]:
+                 timeout_s: float = 120.0,
+                 lane: str = "interactive",
+                 honor_retry_after: bool = True) -> tuple[dict, list]:
     """Drive ``service`` with ``n_requests`` Poisson arrivals at rate
     ``qps``; returns ``(stats, futures)`` where ``futures`` are the
     accepted requests' resolved futures (request i's grid is
     ``grids[i % len(grids)]`` — callers verify answers against a
     reference forward). Every accepted request is waited on before the
     stats are computed, so ``sustained_qps`` is answered-requests over
-    the full wall, not an admission rate."""
+    the full wall, not an admission rate.
+
+    A rejection carrying the server's ``retry_after_s`` hint is retried
+    ONCE after that backoff (``honor_retry_after``) — a polite client
+    honoring ``Retry-After`` instead of booking a blind rejection; a
+    second refusal counts as rejected. The retry is scheduled work like
+    any arrival: the generator stays open-loop."""
     if qps <= 0:
         raise ValueError(f"qps must be > 0, got {qps}")
     if rng is None:
@@ -69,10 +77,11 @@ def poisson_load(service, qps: float, n_requests: int,
     futures: list = []
     submit_t: list[float] = []  # per-future client submit stamp
     rejected = 0
-    for i in range(n_requests):
-        ahead = arrivals[i] - (time.perf_counter() - t0)
-        if ahead > 0:
-            time.sleep(ahead)
+    retried = 0
+    retries: list[tuple[float, int]] = []  # (absolute due stamp, grid i)
+
+    def _try(i: int, may_retry: bool) -> None:
+        nonlocal rejected, retried
         # The generator mints its own trace id per request (the client
         # half of the propagation contract) and stamps the CLIENT clock
         # before the submit call — client-observed latency covers
@@ -84,10 +93,30 @@ def poisson_load(service, qps: float, n_requests: int,
             futures.append(service.submit_voxels(
                 grids[i % len(grids)],
                 trace_id=_tracing.mint_trace_id(),
+                lane=lane,
             ))
             submit_t.append(t_submit)
-        except OverloadError:
-            rejected += 1
+        except OverloadError as e:
+            if may_retry and e.retry_after_s:
+                retried += 1
+                retries.append(
+                    (time.perf_counter() + e.retry_after_s, i)
+                )
+            else:
+                rejected += 1
+
+    for i in range(n_requests):
+        while retries and retries[0][0] <= time.perf_counter():
+            _try(retries.pop(0)[1], may_retry=False)
+        ahead = arrivals[i] - (time.perf_counter() - t0)
+        if ahead > 0:
+            time.sleep(ahead)
+        _try(i, may_retry=honor_retry_after)
+    for due, i in retries:  # leftover honored backoffs after last arrival
+        wait = due - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        _try(i, may_retry=False)
     for fut in futures:
         fut.result(timeout=timeout_s)
     wall = time.perf_counter() - t0
@@ -105,6 +134,7 @@ def poisson_load(service, qps: float, n_requests: int,
         "sustained_qps": round(len(futures) / wall, 1) if wall > 0 else None,
         "accepted": len(futures),
         "rejected": rejected,
+        "retried": retried,
         "p50_ms": round(_pct(lats, 50), 3) if lats else None,
         "p99_ms": round(_pct(lats, 99), 3) if lats else None,
         "client_p50_ms": round(_pct(client, 50), 3) if client else None,

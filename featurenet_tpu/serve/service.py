@@ -36,7 +36,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from featurenet_tpu import obs
+from featurenet_tpu import faults, obs
 from featurenet_tpu.obs import alerts as _alerts
 from featurenet_tpu.obs import windows as _windows
 from featurenet_tpu.serve.batcher import (
@@ -46,6 +46,7 @@ from featurenet_tpu.serve.batcher import (
     ContinuousBatcher,
     PendingRequest,
     normalize_buckets,
+    normalize_lane,
 )
 
 # Default p99 end-to-end SLO for the built-in serving rules. Generous by
@@ -84,10 +85,20 @@ class InferenceService:
                  queue_limit: int = DEFAULT_QUEUE_LIMIT,
                  rules: Optional[Sequence] = None,
                  slo_p99_ms: float = DEFAULT_SLO_P99_MS,
-                 emit_every_s: float = _windows.DEFAULT_EMIT_EVERY_S):
+                 emit_every_s: float = _windows.DEFAULT_EMIT_EVERY_S,
+                 batch_queue_limit: Optional[int] = None,
+                 replica: Optional[str] = None):
         self.predictor = predictor
         self.cfg = predictor.cfg
         self.buckets = normalize_buckets(buckets)
+        # The replica's name in a fleet (None when standalone): echoed in
+        # overload error bodies and /healthz so a router — or a client
+        # reading a 503 — can say WHICH backend rejected it.
+        self.replica = replica
+        # Forward ordinal for the replica_slow fault site (one replica's
+        # forward drags — the latency failure mode the fleet's p99 gate
+        # must survive, distinct from replica death).
+        self._forwards = 0
         # Readiness (the /healthz split): a server is ready only between
         # warmup completing and drain beginning — today a warming or
         # draining process would answer "healthy" to a router probing
@@ -112,9 +123,15 @@ class InferenceService:
             ))
         from featurenet_tpu.obs import perf as _perf
 
+        # Priority lanes: the batch lane defaults to HALF the admission
+        # bound, so deferrable bulk can never starve interactive traffic
+        # of queue room — the documented shed order (batch first).
+        if batch_queue_limit is None:
+            batch_queue_limit = max(1, queue_limit // 2)
         self.batcher = ContinuousBatcher(
             self._forward, buckets=self.buckets, max_wait_ms=max_wait_ms,
             queue_limit=queue_limit,
+            lane_limits={"batch": int(batch_queue_limit)},
             cost_for=costs.get, peaks=_perf.local_device_peaks(),
             # Request tracing (obs.tracing): the config's healthy-traffic
             # sampling rate; a request breaching the serving SLO is
@@ -129,16 +146,25 @@ class InferenceService:
 
     # -- the dispatch hot path ----------------------------------------------
     def _forward(self, bucket: int, padded: np.ndarray):
+        self._forwards += 1
+        if faults.maybe_fail("replica_slow", request=self._forwards):
+            # One replica's forward drags (thermal throttle, a noisy
+            # neighbor, a stuck readback): latency, not death — the
+            # failure mode the SLO alerts and the fleet's least-queue
+            # routing exist for, and one no crash path ever exercises.
+            time.sleep(faults.SLOW_SLEEP_S)
         # lint: allow-host-sync(the readback IS the served response)
         return np.asarray(self.predictor.forward_padded(padded, batch=bucket))
 
     # -- request entry points ------------------------------------------------
     def submit_voxels(self, grid: np.ndarray,
-                      trace_id: Optional[str] = None) -> PendingRequest:
+                      trace_id: Optional[str] = None,
+                      lane: str = "interactive") -> PendingRequest:
         """Enqueue one ``[R,R,R]`` (or ``[R,R,R,1]``) occupancy grid;
-        returns its future. ``OverloadError`` at the admission bound.
-        ``trace_id`` adopts a caller-supplied trace id (propagation);
-        None mints one at admission."""
+        returns its future. ``OverloadError`` at the admission bound (or
+        the request's lane bound — ``batch`` sheds first). ``trace_id``
+        adopts a caller-supplied trace id (propagation); None mints one
+        at admission."""
         # lint: allow-host-sync(host-side request payload, never on device)
         g = np.asarray(grid, dtype=np.float32)
         if g.ndim == 3:
@@ -148,10 +174,12 @@ class InferenceService:
             raise ValueError(
                 f"expected one [{R},{R},{R}(,1)] grid, got {g.shape}"
             )
-        return self.batcher.submit(g, trace_id=trace_id)
+        return self.batcher.submit(g, trace_id=trace_id,
+                                   lane=normalize_lane(lane))
 
     def submit_stl_bytes(self, data: bytes, fill: bool = True,
-                         trace_id: Optional[str] = None) -> PendingRequest:
+                         trace_id: Optional[str] = None,
+                         lane: str = "interactive") -> PendingRequest:
         """The upload path: raw STL bytes → parse → normalize+voxelize →
         enqueue. Geometry runs in the caller's thread (an HTTP worker),
         never the dispatch thread; malformed bytes raise ``ValueError``
@@ -163,7 +191,7 @@ class InferenceService:
         grid = voxelize(tris, self.cfg.resolution, fill=fill)
         # lint: allow-precision(wire contract: the serve input edge is fp32)
         return self.submit_voxels(grid.astype(np.float32),
-                                  trace_id=trace_id)
+                                  trace_id=trace_id, lane=lane)
 
     def format_row(self, row: np.ndarray) -> dict:
         """One request's output row as the wire response: class + top-3
@@ -213,12 +241,15 @@ class InferenceService:
         """The /healthz payload: the readiness split plus uptime and
         the last rolling-window emission seq (a monitor can tell a
         fresh server from one whose windows have moved)."""
-        return {
+        out = {
             "ready": self.ready(),
             "uptime_s": round(time.perf_counter() - self._t_start, 3),
             "window_seq": _windows.last_seq(),
             "queue_depth": self.batcher.stats()["queue_depth"],
         }
+        if self.replica is not None:
+            out["replica"] = self.replica
+        return out
 
     def drain(self, timeout_s: float = 30.0) -> dict:
         """Stop accepting, answer everything admitted, flush the final

@@ -1,0 +1,671 @@
+"""Serving fleet (featurenet_tpu.fleet): router health-gating, spillover
+and re-submit-once semantics over fake replicas, priority-lane shed order
+(batcher lane caps + router-level shed), Retry-After propagation and the
+loadgen honor path, the membership ready-signal re-admission protocol,
+scale verdicts — plus the acceptance spine (ISSUE 14): a REAL 2-replica
+CPU fleet under open-loop HTTP load that survives a ``replica_loss``
+injection with zero admitted-request drops, a roster timeline in the
+report, and the killed replica rejoining from the fleet-shared exec
+cache with zero fresh compiles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from featurenet_tpu import faults, obs
+from featurenet_tpu.elastic.membership import (
+    Membership,
+    read_membership,
+    ready_slots,
+    signal_ready,
+    write_membership,
+)
+from featurenet_tpu.fleet.replica import Candidate, ReplicaManager
+from featurenet_tpu.fleet.router import FleetRouter, scale_verdict
+from featurenet_tpu.obs.report import (
+    build_report,
+    format_report,
+    load_events,
+)
+from featurenet_tpu.serve.batcher import ContinuousBatcher, OverloadError
+
+RES = 16
+
+
+# --- fakes -------------------------------------------------------------------
+
+def _fake_replica(respond):
+    """A scripted replica HTTP server: ``respond(path, body, headers) ->
+    (status, payload_dict, headers_dict)``. Returns (server, port,
+    hits) — ``hits`` collects one record per POST."""
+    hits: list = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: N802
+            pass
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            hits.append({"path": self.path,
+                         "headers": dict(self.headers)})
+            status, payload, extra = respond(
+                self.path, body, dict(self.headers)
+            )
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1], hits
+
+
+def _dead_port() -> int:
+    """A port with nothing listening (bound, then closed) — connecting
+    to it is the replica-just-died shape (connection refused)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class FakeFleet:
+    """The router's provider contract, scripted: a mutable candidate
+    list plus recordings of note_failure / kill_one calls."""
+
+    def __init__(self, cands):
+        self.cands = list(cands)
+        self.failed: list[int] = []
+        self.inflight: dict[int, int] = {}
+        self.killed = 0
+
+    def candidates(self):
+        return sorted(self.cands, key=lambda c: (c.score, c.slot))
+
+    def note_inflight(self, slot, delta):
+        self.inflight[slot] = self.inflight.get(slot, 0) + delta
+
+    def note_failure(self, slot):
+        self.failed.append(slot)
+        self.cands = [c for c in self.cands if c.slot != slot]
+
+    def kill_one(self):
+        self.killed += 1
+        return None
+
+    def ready_count(self):
+        return len(self.cands)
+
+    def stats(self):
+        return {"replicas": len(self.cands)}
+
+
+def _router(fleet, **kw):
+    # rules=() keeps the unit tests from installing a process-wide
+    # window aggregator; a huge scale period keeps the verdict thread
+    # quiet unless a test asks for it.
+    kw.setdefault("rules", ())
+    kw.setdefault("scale_every_s", 3600.0)
+    return FleetRouter(fleet, **kw)
+
+
+# --- batcher priority lanes --------------------------------------------------
+
+def test_batcher_lane_caps_shed_batch_first():
+    """The batch lane rejects at its own cap while interactive traffic
+    still has the rest of the queue — the shed order, at the replica."""
+    gate = threading.Event()
+
+    def blocked(bucket, arr):
+        gate.wait(30)
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b = ContinuousBatcher(blocked, buckets=(1,), max_wait_ms=0,
+                          queue_limit=6, lane_limits={"batch": 2})
+    futs = [b.submit(np.ones((1,)))]  # occupies the dispatcher
+    time.sleep(0.2)
+    futs += [b.submit(np.ones((1,)), lane="batch") for _ in range(2)]
+    with pytest.raises(OverloadError) as ei:
+        b.submit(np.ones((1,)), lane="batch")
+    assert ei.value.lane == "batch"
+    assert ei.value.retry_after_s and ei.value.retry_after_s >= 0.05
+    assert ei.value.response["lane"] == "batch"
+    # Interactive still has headroom: the global bound is 6, only 2 are
+    # queued — the batch cap tripped first, exactly the shed order.
+    futs.append(b.submit(np.ones((1,))))
+    st = b.stats()
+    assert st["by_lane"]["batch"]["rejected"] == 1
+    assert st["by_lane"]["batch"]["limit"] == 2
+    gate.set()
+    for f in futs:
+        f.result(30)
+    st = b.drain()
+    assert st["served"] == 4 and st["rejected"] == 1
+
+
+def test_unknown_lane_normalizes_to_interactive():
+    b = ContinuousBatcher(lambda bucket, arr: arr, buckets=(1,),
+                          max_wait_ms=1, queue_limit=2)
+    fut = b.submit(np.ones((1,)), lane="totally-bogus")
+    assert fut.lane == "interactive"
+    b.drain()
+    with pytest.raises(ValueError, match="lane"):
+        ContinuousBatcher(lambda bucket, arr: arr, buckets=(1,),
+                          lane_limits={"bogus": 1})
+
+
+# --- HTTP overload contract: Retry-After + replica field ---------------------
+
+def test_http_503_carries_retry_after_and_replica(tmp_path):
+    """The overload satellite: the 503 body grows lane/retry_after_s/
+    replica and the Retry-After header carries the same hint."""
+    import http.client
+    import types
+
+    from featurenet_tpu.serve.http import make_server
+
+    def reject(data, trace_id=None, lane="interactive"):
+        raise OverloadError(5, 4, trace_id=trace_id, lane=lane,
+                            retry_after_s=0.1)
+
+    service = types.SimpleNamespace(
+        replica="r7",
+        batcher=types.SimpleNamespace(retry_after_s=0.1),
+        submit_stl_bytes=reject,
+    )
+    srv = make_server(service, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10
+        )
+        conn.request("POST", "/predict", body=b"x",
+                     headers={"X-Featurenet-Priority": "batch",
+                              "X-Featurenet-Trace": "fleet-test-1"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read().decode())
+        assert resp.status == 503
+        assert body["error"] == "overload"
+        assert body["replica"] == "r7"
+        assert body["lane"] == "batch"
+        assert body["retry_after_s"] == 0.1
+        assert float(resp.getheader("Retry-After")) == pytest.approx(0.1)
+        assert resp.getheader("X-Featurenet-Trace") == "fleet-test-1"
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_poisson_loadgen_honors_retry_after():
+    """A rejection carrying retry_after_s is retried once after the
+    backoff instead of booking a blind rejection."""
+    from featurenet_tpu.serve.batcher import PendingRequest
+    from featurenet_tpu.serve.loadgen import poisson_load
+
+    class Service:
+        class cfg:
+            resolution = 4
+
+        def __init__(self):
+            self.calls = 0
+
+        def submit_voxels(self, grid, trace_id=None, lane="interactive"):
+            self.calls += 1
+            if self.calls == 1:
+                raise OverloadError(4, 4, trace_id="t1",
+                                    retry_after_s=0.02)
+            p = PendingRequest(grid)
+            p.value = 0
+            p.t_done = time.perf_counter()
+            p._event.set()
+            return p
+
+        def stats(self):
+            return {"occupancy": None, "by_bucket": {}}
+
+    svc = Service()
+    stats, futs = poisson_load(svc, qps=500, n_requests=3)
+    assert stats["rejected"] == 0 and stats["retried"] == 1
+    assert len(futs) == 3
+    svc2 = Service()
+    stats2, _ = poisson_load(svc2, qps=500, n_requests=3,
+                             honor_retry_after=False)
+    assert stats2["rejected"] == 1 and stats2["retried"] == 0
+
+
+# --- router: health gating / spillover / re-submit / lanes -------------------
+
+def _ok_replica(label=3):
+    def respond(path, body, headers):
+        return 200, {"label": label,
+                     "trace": headers.get("X-Featurenet-Trace")}, {}
+    return _fake_replica(respond)
+
+
+def test_router_health_gates_and_picks_least_queue():
+    srv_a, port_a, hits_a = _ok_replica(1)
+    srv_b, port_b, hits_b = _ok_replica(2)
+    srv_c, port_c, hits_c = _ok_replica(9)  # NOT in the candidate set
+    fleet = FakeFleet([
+        Candidate(0, "127.0.0.1", port_a, 0),
+        Candidate(1, "127.0.0.1", port_b, 5),
+    ])
+    router = _router(fleet)
+    try:
+        for _ in range(4):
+            status, data, headers = router.route("/predict_voxels", b"g")
+            assert status == 200
+            assert json.loads(data.decode())["label"] == 1
+        # Least-queue wins everything at these scores; the unlisted
+        # (unhealthy) replica never sees a byte.
+        assert len(hits_a) == 4 and not hits_b and not hits_c
+        assert router.stats()["answered"] == 4
+    finally:
+        router.drain()
+        for s in (srv_a, srv_b, srv_c):
+            s.shutdown()
+
+
+def test_router_spillover_preserves_trace(tmp_path):
+    """A replica's overload 503 becomes 'try the next healthy replica'
+    with the SAME trace id; the fleet answers 200."""
+    obs.init_run(str(tmp_path / "run"), process_index=0)
+
+    def overloaded(path, body, headers):
+        return 503, {"error": "overload", "queue_depth": 9, "limit": 8,
+                     "retry_after_s": 0.07}, {"Retry-After": "0.070"}
+
+    srv_a, port_a, hits_a = _fake_replica(overloaded)
+    srv_b, port_b, hits_b = _ok_replica(5)
+    fleet = FakeFleet([
+        Candidate(0, "127.0.0.1", port_a, 0),   # least loaded → tried 1st
+        Candidate(1, "127.0.0.1", port_b, 3),
+    ])
+    router = _router(fleet)
+    try:
+        status, data, headers = router.route(
+            "/predict_voxels", b"g", trace_id="spill-trace-7"
+        )
+        assert status == 200
+        body = json.loads(data.decode())
+        # The replica that answered saw the ORIGINAL trace id.
+        assert body["trace"] == "spill-trace-7"
+        assert headers["X-Featurenet-Trace"] == "spill-trace-7"
+        assert len(hits_a) == 1 and len(hits_b) == 1
+        assert router.stats()["spillovers"] == 1
+    finally:
+        router.drain()
+        obs.close_run()
+        srv_a.shutdown()
+        srv_b.shutdown()
+    events, _ = load_events(str(tmp_path / "run"))
+    sp = [e for e in events if e["ev"] == "fleet_spillover"]
+    assert len(sp) == 1 and sp[0]["trace"] == "spill-trace-7" \
+        and sp[0]["from_replica"] == 0
+
+
+def test_router_fleet_wide_503_when_every_lane_full():
+    def overloaded(path, body, headers):
+        return 503, {"error": "overload", "queue_depth": 9,
+                     "limit": 8}, {"Retry-After": "0.090"}
+
+    srv_a, port_a, _ = _fake_replica(overloaded)
+    fleet = FakeFleet([Candidate(0, "127.0.0.1", port_a, 0)])
+    router = _router(fleet)
+    try:
+        status, data, headers = router.route("/predict_voxels", b"g")
+        assert status == 503
+        body = json.loads(data.decode())
+        assert body["error"] == "overload" and body["fleet"] is True
+        # The walk's last replica hint rides out on the fleet answer.
+        assert float(headers["Retry-After"]) == pytest.approx(0.09)
+        st = router.stats()
+        assert st["rejected"] == 1 and st["spillovers"] == 1
+    finally:
+        router.drain()
+        srv_a.shutdown()
+
+
+def test_router_resubmits_once_to_survivor(tmp_path):
+    """The replica-loss path: a connection dying mid-request re-submits
+    ONCE to a survivor (idempotent — classification is pure); the dead
+    replica is gated out of the candidate set immediately."""
+    obs.init_run(str(tmp_path / "run"), process_index=0)
+    srv_b, port_b, hits_b = _ok_replica(4)
+    fleet = FakeFleet([
+        Candidate(0, "127.0.0.1", _dead_port(), 0),  # dies on connect
+        Candidate(1, "127.0.0.1", port_b, 2),
+    ])
+    router = _router(fleet)
+    try:
+        status, data, headers = router.route(
+            "/predict_voxels", b"g", trace_id="resubmit-trace-1"
+        )
+        assert status == 200
+        assert json.loads(data.decode())["trace"] == "resubmit-trace-1"
+        st = router.stats()
+        assert st["resubmits"] == 1 and st["dropped"] == 0
+        assert fleet.failed == [0]
+        assert len(hits_b) == 1
+    finally:
+        router.drain()
+        obs.close_run()
+        srv_b.shutdown()
+    events, _ = load_events(str(tmp_path / "run"))
+    rs = [e for e in events if e["ev"] == "fleet_resubmit"]
+    assert len(rs) == 1 and rs[0]["from_replica"] == 0
+
+
+def test_router_drops_after_second_connection_death():
+    """Re-submit ONCE means once: two replicas dying under the same
+    request is an honest 502 drop — the third healthy replica is NOT
+    tried (no retry storms), and the drop lands in the counter the
+    gate pins at zero."""
+    srv_c, port_c, hits_c = _ok_replica(1)
+    fleet = FakeFleet([
+        Candidate(0, "127.0.0.1", _dead_port(), 0),
+        Candidate(1, "127.0.0.1", _dead_port(), 1),
+        Candidate(2, "127.0.0.1", port_c, 2),
+    ])
+    router = _router(fleet)
+    try:
+        status, data, _ = router.route("/predict_voxels", b"g")
+        assert status == 502
+        assert json.loads(data.decode())["error"] == "replica_lost"
+        st = router.stats()
+        assert st["dropped"] == 1 and st["resubmits"] == 1
+        assert not hits_c  # once means once
+    finally:
+        router.drain()
+        srv_c.shutdown()
+
+
+def test_router_sheds_batch_lane_first(tmp_path):
+    """Router-level shed order: when every healthy replica sits above
+    the batch pressure bar, batch is shed immediately (503 +
+    Retry-After, no replica touched) while interactive still routes."""
+    obs.init_run(str(tmp_path / "run"), process_index=0)
+    srv_a, port_a, hits_a = _ok_replica(2)
+    fleet = FakeFleet([Candidate(0, "127.0.0.1", port_a, 9)])
+    router = _router(fleet, batch_shed_depth=8)
+    try:
+        status, data, headers = router.route(
+            "/predict_voxels", b"g", lane="batch"
+        )
+        assert status == 503
+        body = json.loads(data.decode())
+        assert body["shed"] is True and body["lane"] == "batch"
+        assert "Retry-After" in headers
+        assert not hits_a  # shed before any replica was occupied
+        status, _, _ = router.route("/predict_voxels", b"g",
+                                    lane="interactive")
+        assert status == 200 and len(hits_a) == 1
+        st = router.stats()
+        assert st["shed"] == 1 and st["answered"] == 1
+    finally:
+        router.drain()
+        obs.close_run()
+        srv_a.shutdown()
+    events, _ = load_events(str(tmp_path / "run"))
+    shed = [e for e in events if e["ev"] == "fleet_shed"]
+    assert len(shed) == 1 and shed[0]["lane"] == "batch"
+
+
+def test_scale_verdict_units():
+    # No routable replica → add, regardless of latency history.
+    assert scale_verdict(None, 0.0, ready=0) == "add"
+    # SLO breach → add.
+    assert scale_verdict(400.0, 0.0, ready=2, slo_p99_ms=250.0) == "add"
+    # Queue pressure building → add, even under the SLO.
+    assert scale_verdict(50.0, 20.0, ready=2, slo_p99_ms=250.0) == "add"
+    # Oversized: multiple replicas, idle queues, far under SLO → shed.
+    assert scale_verdict(10.0, 0.0, ready=3, slo_p99_ms=250.0) == "shed"
+    # A single replica never sheds below 1.
+    assert scale_verdict(10.0, 0.0, ready=1, slo_p99_ms=250.0) == "hold"
+    # In between → hold.
+    assert scale_verdict(200.0, 2.0, ready=2, slo_p99_ms=250.0) == "hold"
+
+
+# --- membership ready-signal re-admission ------------------------------------
+
+def test_membership_ready_signal_roundtrip(tmp_path):
+    rd = str(tmp_path)
+    # No membership yet: nothing to signal against; the agent polls.
+    assert signal_ready(rd, 1) is False
+    write_membership(rd, Membership(0, (0, 2), 1, "start"))
+    assert ready_slots(rd) == set()
+    assert signal_ready(rd, 1) is True
+    assert ready_slots(rd) == {1}
+    m = read_membership(rd)
+    assert m.members == (0, 2) and m.ready == (1,)
+    # A serving member has nothing to signal; idempotent for signals.
+    assert signal_ready(rd, 0) is True
+    assert signal_ready(rd, 1) is True
+    assert ready_slots(rd) == {1}
+    # Pre-agent documents (no "ready" key) keep reading.
+    with open(os.path.join(rd, "membership.json")) as fh:
+        doc = json.load(fh)
+    del doc["ready"]
+    with open(os.path.join(rd, "membership.json"), "w") as fh:
+        json.dump(doc, fh)
+    assert read_membership(rd).ready == ()
+
+
+def test_coordinator_agent_readmit_waits_for_signal(tmp_path):
+    """readmit='agent': a lost slot stays out at the first boundary (no
+    signal) and rejoins at the boundary AFTER its agent writes the slot
+    into membership.json — the external-host re-admission satellite."""
+    from featurenet_tpu.elastic import ElasticCoordinator, heartbeat_path
+
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir, exist_ok=True)
+
+    def beat_then(code, hb):
+        return [sys.executable, "-c",
+                "import os, time\n"
+                f"hb = {hb!r}\n"
+                "time.sleep(0.25); open(hb, 'a').close(); "
+                "os.utime(hb, None)\n"
+                "time.sleep(0.1)\n"
+                + code]
+
+    signal_code = (
+        "from featurenet_tpu.elastic.membership import signal_ready\n"
+        f"signal_ready({run_dir!r}, 1)\n"
+        "raise SystemExit(75)"
+    )
+    scenario = {
+        (0, 0): "import time; time.sleep(60)",   # killed in the re-form
+        (0, 1): "raise SystemExit(9)",           # the loss
+        (1, 0): "raise SystemExit(75)",          # boundary, NO signal yet
+        (2, 0): signal_code,                     # agent signals, boundary
+        # gen 3: both slots default to exit 0 → done at full strength.
+    }
+
+    def spawn(members, rank, generation, port):
+        slot = members[rank]
+        code = scenario.get((generation, slot), "raise SystemExit(0)")
+        return beat_then(code, heartbeat_path(run_dir, slot))
+
+    res = ElasticCoordinator(
+        2, spawn, run_dir, min_world_size=1, global_batch=8,
+        local_devices=2, poll_s=0.1, grace_s=30.0, stall_timeout_s=30.0,
+        backoff_base_s=0.05, readmit="agent", log=lambda _: None,
+    ).run()
+    assert res.exit_code == 0
+    assert res.losses == 1 and res.rejoins == 1
+    # Two planned cuts: the unsignaled boundary held the world at 1.
+    assert res.planned == 2
+    reforms = []
+    with open(os.path.join(run_dir, "events.jsonl")) as fh:
+        for line in fh:
+            e = json.loads(line)
+            if e.get("ev") == "mesh_reform":
+                reforms.append((e["from_n"], e["to_n"], e["reason"]))
+            if e.get("ev") == "host_join":
+                assert e["host"] == 1 and e["generation"] == 3
+    assert reforms == [(0, 2, "start"), (2, 1, "host_loss"),
+                       (1, 2, "host_rejoin")]
+    m = read_membership(run_dir)
+    assert m.members == (0, 1)
+    # The admission consumed the signal.
+    assert m.ready == ()
+    with pytest.raises(ValueError, match="readmit"):
+        ElasticCoordinator(2, spawn, run_dir, readmit="bogus")
+
+
+def test_cli_fleet_requires_run_dir():
+    from featurenet_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="run-dir"):
+        cli_main(["fleet", "--checkpoint-dir", "/nonexistent"])
+
+
+# --- the acceptance e2e ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_ckpt(tmp_path_factory):
+    """A real trained smoke16 checkpoint the replica children serve."""
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.train import Trainer
+
+    d = str(tmp_path_factory.mktemp("fleet_ckpt") / "ckpt")
+    cfg = get_config(
+        "smoke16", total_steps=6, eval_every=10**9, checkpoint_every=6,
+        log_every=6, checkpoint_dir=d, data_workers=1,
+    )
+    Trainer(cfg).run()
+    return d
+
+
+def test_fleet_e2e_replica_loss_zero_drops_cached_rejoin(
+    fleet_ckpt, tmp_path
+):
+    """ISSUE 14 acceptance: a 2-replica CPU fleet under open-loop HTTP
+    load survives a ``replica_loss`` injection — zero admitted-request
+    drops, the in-flight work re-submits to the survivor, the killed
+    replica rejoins from the fleet-SHARED exec cache with zero fresh
+    compiles, and the report renders the roster timeline."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.fleet.loadgen import http_load, replica_argv
+
+    run_dir = str(tmp_path / "run")
+    cache_dir = str(tmp_path / "exec_cache")
+    obs.init_run(run_dir, process_index=0, extra={"cmd": "fleet-e2e"})
+    # The chaos arm: SIGKILL a live replica at the router's 40th routed
+    # request (the router-side site; the manager's marker dir keeps it
+    # one-shot for the run).
+    faults.install("replica_loss@request=40", state_dir=run_dir,
+                   only={"replica_loss"})
+
+    def spawn(slot, hb):
+        return replica_argv(
+            fleet_ckpt, slot, hb, run_dir=run_dir,
+            exec_cache_dir=cache_dir, buckets="1,2", max_wait_ms=3.0,
+            queue_limit=64,
+        )
+
+    manager = ReplicaManager(2, spawn, run_dir)
+    router = FleetRouter(manager, slo_p99_ms=2000.0, scale_every_s=0.5)
+    srv = None
+    try:
+        manager.start()
+        deadline = time.monotonic() + 420
+        while manager.ready_count() < 2:
+            assert time.monotonic() < deadline, \
+                f"fleet warmup timed out: {manager.stats()}"
+            time.sleep(0.25)
+        srv = router.make_server("127.0.0.1", 0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        obs.emit("fleet_start", replicas=2, host="127.0.0.1", port=port)
+        grids = generate_batch(
+            np.random.default_rng(0), 16, RES
+        )["voxels"]
+        stats, outcomes = http_load(
+            "127.0.0.1", port, qps=80.0, n_requests=240, grids=grids
+        )
+        # The whole promise: NOTHING admitted was dropped, through a
+        # replica SIGKILLed mid-stream.
+        assert stats["dropped"] == 0, (stats, router.stats())
+        assert stats["answered"] + stats["rejected"] == 240
+        assert stats["answered"] >= 200, stats
+        assert stats["p99_ms"] is not None
+        for o in outcomes:
+            if o and o.get("status") == 200:
+                assert isinstance(o["label"], int)
+        # The kill fired and at least one in-flight request re-submitted
+        # to the survivor.
+        st = router.stats()
+        assert manager.stats()["losses"] >= 1, manager.stats()
+        assert st["resubmits"] >= 1, st
+        # Rejoin: the respawned replica comes back ready (seconds — it
+        # warms its whole bucket ladder from the shared exec cache).
+        t_rejoin = time.monotonic() + 300
+        while manager.ready_count() < 2:
+            assert time.monotonic() < t_rejoin, \
+                f"rejoin timed out: {manager.stats()}"
+            time.sleep(0.25)
+        assert manager.stats()["rejoins"] >= 1
+        srv.shutdown()
+        srv = None
+        st = router.drain()
+        assert st["exit_code"] == 0, st
+        assert st["dropped"] == 0
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        manager.stop()
+        obs.close_run()
+        faults.uninstall()
+    # --- post-hoc: roster timeline, zero fresh compiles on rejoin ----------
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    losses = [e for e in events if e["ev"] == "fleet_replica_loss"]
+    readies = [e for e in events if e["ev"] == "fleet_replica_ready"]
+    assert losses, "no fleet_replica_loss event"
+    t_loss = losses[0]["t"]
+    # 2 initial readies + the rejoin (all loss victims eventually ready).
+    assert len(readies) >= 3
+    assert any(e["t"] > t_loss for e in readies)
+    # Zero fresh compiles after the loss: the respawned replica warms
+    # every bucket from the fleet-shared exec cache (cache_hit events),
+    # never the XLA compiler.
+    compiles_after = [e for e in events
+                     if e["ev"] == "program_compile" and e["t"] > t_loss]
+    assert not compiles_after, compiles_after
+    assert [e for e in events
+            if e["ev"] == "cache_hit" and e["t"] > t_loss]
+    # Scale verdicts were advisory events, not load-bearing.
+    assert [e for e in events if e["ev"] == "fleet_scale"]
+    # The roster file is the elastic schema, final state = full strength.
+    m = read_membership(run_dir)
+    assert m is not None and m.members == (0, 1)
+    assert m.reason == "replica_rejoin"
+    # The report folds it all: fleet section + mesh-style timeline.
+    rep = build_report(events)
+    assert rep["fleet"]["losses"] >= 1
+    assert rep["fleet"]["resubmits"] >= 1
+    assert rep["fleet"]["dropped"] == 0
+    assert any(e["event"] == "fleet_replica_loss"
+               for e in rep["fleet"]["timeline"])
+    text = format_report(rep)
+    assert "fleet:" in text and "scale verdicts" in text

@@ -203,7 +203,12 @@ def test_fast_reject_at_queue_bound(tmp_path):
     assert time.perf_counter() - t0 < 5  # fast-reject, no deadline wait
     assert ei.value.response == {
         "error": "overload", "queue_depth": 3, "limit": 3,
+        # The wire shape grew the shed lane and the server's honest
+        # backoff hint (surfaced as the HTTP Retry-After header).
+        "lane": "interactive",
+        "retry_after_s": ei.value.retry_after_s,
     }
+    assert ei.value.retry_after_s and ei.value.retry_after_s >= 0.05
     gate.set()
     for f in futs:
         f.result(30)
@@ -319,6 +324,31 @@ def test_queue_wait_window_and_serving_metric_predicate():
     assert by_metric["serving_p99_ms"].severity == "critical"
     assert by_metric["queue_wait_ms_p99"].threshold == 42.0
     assert "data_wait_fraction" in by_metric  # defaults still present
+
+
+def test_replica_slow_fault_drags_forward(predictor):
+    """The replica_slow injection site (fleet chaos): the Nth dispatched
+    forward sleeps SLOW_SLEEP_S — latency, not death; the service keeps
+    answering (one-shot: the next dispatch runs at full speed)."""
+    from featurenet_tpu import faults
+
+    faults.install("replica_slow@request=1")
+    svc = InferenceService(predictor, buckets=(1,), max_wait_ms=1,
+                           rules=())
+    try:
+        grid = _grid()
+        t0 = time.perf_counter()
+        row = svc.predict(svc.submit_voxels(grid), timeout=30)
+        dragged = time.perf_counter() - t0
+        assert dragged >= faults.SLOW_SLEEP_S
+        assert "label" in row
+        # One-shot: the second dispatch does not pay the sleep again.
+        t0 = time.perf_counter()
+        svc.predict(svc.submit_voxels(grid), timeout=30)
+        assert time.perf_counter() - t0 < faults.SLOW_SLEEP_S
+    finally:
+        faults.uninstall()
+        svc.drain()
 
 
 # --- the service: warm ladder + open-loop load-gen e2e (acceptance) ----------
