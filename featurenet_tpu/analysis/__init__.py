@@ -33,6 +33,11 @@ Rules (each a pure function over the parsed tree; see ``rules.py``):
 - ``config-cli``  — every CLI override flag maps to a real ``Config`` field
                     and every field is CLI-reachable or explicitly exempted
                     (stale exemptions are themselves findings).
+- ``raw-conn``    — ``http.client.HTTPConnection`` construction outside
+                    ``fleet/pool.py`` (the one module allowed to open wire
+                    channels — everything else checks one out of the pool);
+                    suppress a deliberate one-shot with
+                    ``# lint: allow-raw-conn(<reason>)``.
 
 Surfaced as ``python -m featurenet_tpu.cli lint [--json] [--rule NAME]``
 (exit 2 on findings) and run self-clean inside tier-1

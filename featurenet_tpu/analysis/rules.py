@@ -1,4 +1,4 @@
-"""The five contract rules (see the package docstring for the catalog).
+"""The contract rules (see the package docstring for the catalog).
 
 Each rule is a pure function ``(Tree) -> [Finding]`` registered under its
 family name. The contract *sources* are imported, not duplicated: the
@@ -247,9 +247,12 @@ HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py",
                     # router/manager process must survive every replica,
                     # so it owns no device and every request it touches
                     # stays bytes — a host sync here would couple the
-                    # fleet's availability to one child's backend.
+                    # fleet's availability to one child's backend. The
+                    # connection pool is the per-request wire hop itself
+                    # (every forward and probe checks a channel out), so
+                    # it sits under the same discipline.
                     "fleet/replica.py", "fleet/router.py",
-                    "fleet/loadgen.py")
+                    "fleet/loadgen.py", "fleet/pool.py")
 
 
 def _is_host_sync(node: ast.Call) -> Optional[str]:
@@ -802,7 +805,56 @@ def span_names_rule(tree: Tree) -> list[Finding]:
     return findings
 
 
-# --- rule 7: alert-rule fragments in docs/help vs known_metrics --------------
+# --- rule 7: raw-connection discipline ---------------------------------------
+
+# The one module allowed to construct HTTP connections: the fleet's
+# channel pool. Every other call site checks a channel out of a pool —
+# a raw construction elsewhere is connect-per-request sneaking back in,
+# the exact churn PR 15 removed from the serving data plane.
+POOL_MODULE = "fleet/pool.py"
+
+_RAW_CONN_NAMES = ("HTTPConnection", "HTTPSConnection")
+
+
+@register("raw-conn")
+def raw_conn_rule(tree: Tree) -> list[Finding]:
+    """Raw ``http.client.HTTPConnection(...)`` construction outside
+    ``fleet/pool.py``. The pool is where broken-socket retirement,
+    max-age/idle bounds, and the ``conn_open``/``conn_reuse``/
+    ``conn_retire`` telemetry live — a raw connection bypasses all of
+    it and silently reintroduces a handshake per request. A deliberate
+    one-shot connection (a single-socket stream client, a test harness
+    inside the package) carries
+    ``# lint: allow-raw-conn(<why one raw connection is the point>)``.
+    """
+    findings: list[Finding] = []
+    for mod in tree.modules:
+        if mod.relpath == POOL_MODULE:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if name not in _RAW_CONN_NAMES:
+                continue
+            if mod.suppressed(node.lineno, "raw-conn"):
+                continue
+            findings.append(Finding(
+                "raw-conn", "raw_connection", mod.path, node.lineno,
+                f"raw {name}(...) outside {POOL_MODULE} — construct "
+                "channels through fleet.pool.ConnectionPool (checkout/"
+                "post/get) so retirement, bounds, and conn_* telemetry "
+                "apply, or annotate the line with # lint: "
+                "allow-raw-conn(<why a one-shot connection is the "
+                "point>)",
+            ))
+    return findings
+
+
+# --- rule 8: alert-rule fragments in docs/help vs known_metrics --------------
 
 # An alert-DSL fragment: metric OP number [":" severity], with NO
 # whitespace around the operator (prose like "augment_groups > 0" is not a

@@ -583,10 +583,12 @@ def main(argv=None) -> None:
     p_hist = sub.add_parser("bench-history", allow_abbrev=False,
                             help="one-table summary across BENCH_r*.json "
                                  "rounds (featurenet_tpu.obs."
-                                 "bench_history): throughput/MFU/serving "
-                                 "pins per round; skipped rounds render "
-                                 "with their structured reason instead "
-                                 "of vanishing")
+                                 "bench_history): throughput/MFU/serving/"
+                                 "fleet pins per round (incl. "
+                                 "fleet_conn_reuse_ratio — the pooled "
+                                 "data plane's trajectory); skipped "
+                                 "rounds render with their structured "
+                                 "reason instead of vanishing")
     p_hist.add_argument("bench_dir", nargs="?", default=".",
                         help="directory holding the BENCH_r*.json "
                              "artifacts (default: the current dir)")
@@ -642,10 +644,14 @@ def main(argv=None) -> None:
                             "regressions; requires --run-dir")
     p_srv = sub.add_parser("serve", allow_abbrev=False,
                            help="always-on inference service "
-                                "(featurenet_tpu.serve): HTTP front end "
-                                "feeding a continuous batcher over a "
-                                "ladder of pre-built serving executables; "
+                                "(featurenet_tpu.serve): HTTP/1.1 "
+                                "keep-alive front end feeding a "
+                                "continuous batcher over a ladder of "
+                                "pre-built serving executables; "
                                 "POST /predict with raw STL bytes, "
+                                "POST /predict_voxels_stream pipelines "
+                                "length-prefixed voxel frames over one "
+                                "socket (one JSON line per frame), "
                                 "GET /stats for counters; overload "
                                 "fast-rejects with a structured 503")
     p_srv.add_argument("--checkpoint-dir", required=True)
@@ -746,7 +752,9 @@ def main(argv=None) -> None:
                            help="elastic serving fleet "
                                 "(featurenet_tpu.fleet): N supervised "
                                 "`cli serve` replicas behind one router "
-                                "— health-gated least-queue routing, "
+                                "— health-gated least-queue routing over "
+                                "pooled keep-alive channels (forwards "
+                                "and /healthz probes share fleet.pool), "
                                 "overload spillover, re-submit-once on "
                                 "replica loss, priority-lane shedding, "
                                 "Retry-After backoff, advisory "

@@ -25,10 +25,17 @@ fault-tolerant serving layer:
   only when every lane is full, and advisory SLO-driven scaling
   verdicts (``fleet_scale{verdict: add|shed|hold}``) off the rolling
   serving windows.
+- ``pool``: the persistent-connection layer — a bounded, health-aware
+  keep-alive channel pool (check-out/check-in, max-idle/max-age
+  retirement, broken-socket detection with a stale-reuse fresh retry
+  that preserves the router's re-submit-once semantics) shared by the
+  router's forwards and the manager's ``/healthz`` probes; the one
+  module allowed to construct raw HTTP connections (``raw-conn`` lint).
 - ``loadgen``: the open-loop HTTP load generator (honors
-  ``Retry-After``) and the bench entry point that pins
-  ``fleet_qps_sustained`` / ``fleet_p99_ms`` / ``fleet_requests_dropped``
-  through a mid-run replica kill.
+  ``Retry-After``, keep-alive channel set with ``reconnects`` counted)
+  and the bench entry point that pins ``fleet_qps_sustained`` /
+  ``fleet_p99_ms`` / ``fleet_requests_dropped`` /
+  ``fleet_conn_reuse_ratio`` through a mid-run replica kill.
 
 Launch with ``cli fleet --replicas N --checkpoint-dir D --run-dir R``.
 """

@@ -9,13 +9,22 @@ lane header. A 503 carrying ``Retry-After`` is honored ONCE (sleep the
 hinted backoff, retry) before counting as a rejection — the polite-
 client half of the admission contract.
 
+The generator keeps a small keep-alive connection set (``fleet.pool``)
+instead of reconnecting per request — the client half of the persistent
+data plane. ``reconnects`` in the stats counts fresh connects beyond the
+working set the thread-pool concurrency needed anyway, so client-side
+channel churn (retirements, broken sockets) is visible in the bench row
+rather than hiding inside the latency numbers.
+
 ``bench_fleet`` is the bench.py entry point: a 2-replica CPU fleet
 (replicas forced onto ``JAX_PLATFORMS=cpu`` — the row pins the ROUTER
 layer's robustness, deliberately independent of accelerator health),
 open-loop load with one replica SIGKILLed mid-run, returning the pinned
 ``fleet_qps_sustained`` / ``fleet_p99_ms`` / ``fleet_requests_dropped``
 fields — the last with a baseline of 0: the fleet's whole promise is
-that admitted work survives replica loss.
+that admitted work survives replica loss — plus ``fleet_conn_reuse_ratio``
+(router-side channel reuse over the whole run, pinned min: the pooling
+payoff must not silently rot back to connect-per-request).
 """
 
 from __future__ import annotations
@@ -35,17 +44,18 @@ import numpy as np
 from featurenet_tpu.obs import tracing as _tracing
 from featurenet_tpu.obs.report import _pct
 from featurenet_tpu.obs.tracing import TRACE_HEADER
-from featurenet_tpu.fleet.router import post_once
+from featurenet_tpu.fleet.pool import ConnectionPool
 from featurenet_tpu.serve.http import PRIORITY_HEADER
 
 
-def _post(host: str, port: int, path: str, body: bytes, lane: str,
+def _post(pool: ConnectionPool, host: str, port: int, path: str,
+          body: bytes, lane: str,
           timeout_s: float) -> tuple[int, dict, Optional[float]]:
-    """One POST; returns (status, parsed body, Retry-After seconds).
-    Connection-level failures raise OSError/HTTPException upward.
-    Rides the router's ``post_once`` — one hop implementation for the
-    whole fleet package."""
-    status, raw, ra = post_once(host, port, path, body, {
+    """One pooled POST; returns (status, parsed body, Retry-After
+    seconds). Connection-level failures raise OSError/HTTPException
+    upward. Rides ``fleet.pool`` — the one hop implementation for the
+    whole fleet package, client side included."""
+    status, raw, ra = pool.post(host, port, path, body, {
         TRACE_HEADER: _tracing.mint_trace_id(),
         PRIORITY_HEADER: lane,
     }, timeout_s)
@@ -68,13 +78,22 @@ def http_load(host: str, port: int, qps: float, n_requests: int,
     and label. Open-loop: arrivals are pre-scheduled; a slow fleet is
     submitted to late but never slower. Every request runs on a worker
     thread (the HTTP POST blocks for the full serving latency — the
-    thread pool is the client's concurrency, not the load's clock)."""
+    thread pool is the client's concurrency, not the load's clock) and
+    rides a keep-alive channel set sized to the worker pool, so the
+    client pays ~max_workers handshakes for the whole run instead of
+    one per request; ``reconnects`` in the stats is the churn beyond
+    that working set."""
     from concurrent.futures import ThreadPoolExecutor
 
     if qps <= 0:
         raise ValueError(f"qps must be > 0, got {qps}")
     if rng is None:
         rng = np.random.default_rng(0)
+    # The client's keep-alive connection set: one idle slot per worker
+    # thread (the natural concurrency bound), generous max-age — the
+    # run IS the channel's useful lifetime.
+    pool = ConnectionPool(max_idle_per_endpoint=max_workers,
+                          max_age_s=600.0, timeout_s=timeout_s)
     payloads = [
         # lint: allow-host-sync(client-side wire encoding of host arrays)
         np.ascontiguousarray(
@@ -89,7 +108,7 @@ def http_load(host: str, port: int, qps: float, n_requests: int,
         t_submit = time.perf_counter()
         body = payloads[i % len(payloads)]
         try:
-            status, doc, ra = _post(host, port, "/predict_voxels",
+            status, doc, ra = _post(pool, host, port, "/predict_voxels",
                                     body, lane, timeout_s)
             retried = False
             if status == 503 and honor_retry_after and ra:
@@ -102,7 +121,8 @@ def http_load(host: str, port: int, qps: float, n_requests: int,
                 # fleet_p99_ms by the whole Retry-After on every round
                 # whose kill lands slightly differently.
                 t_submit = time.perf_counter()
-                status, doc, ra = _post(host, port, "/predict_voxels",
+                status, doc, ra = _post(pool, host, port,
+                                        "/predict_voxels",
                                         body, lane, timeout_s)
         except (OSError, http.client.HTTPException) as e:
             outcomes[i] = {"status": None, "error": str(e)}
@@ -116,16 +136,18 @@ def http_load(host: str, port: int, qps: float, n_requests: int,
         }
 
     t0 = time.perf_counter()
-    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+    with ThreadPoolExecutor(max_workers=max_workers) as workers:
         futs = []
         for i in range(n_requests):
             ahead = arrivals[i] - (time.perf_counter() - t0)
             if ahead > 0:
                 time.sleep(ahead)
-            futs.append(pool.submit(one, i))
+            futs.append(workers.submit(one, i))
         for f in futs:
             f.result()
     wall = time.perf_counter() - t0
+    conn = pool.stats()
+    pool.close()
     done = [o for o in outcomes if o is not None]
     ok = [o for o in done if o.get("status") == 200]
     rejected = sum(1 for o in done if o.get("status") == 503)
@@ -148,6 +170,12 @@ def http_load(host: str, port: int, qps: float, n_requests: int,
         "retried": sum(1 for o in done if o.get("retried")),
         "p50_ms": round(_pct(lats, 50), 3) if lats else None,
         "p99_ms": round(_pct(lats, 99), 3) if lats else None,
+        # Client-side channel churn: handshakes paid for the whole run
+        # (≈ the worker-pool concurrency when pooling works) and the
+        # reconnects beyond that working set (retired/broken channels).
+        "connects": conn["opened"],
+        "conn_reuses": conn["reused"],
+        "reconnects": conn["reconnects"],
     }
     return stats, outcomes
 
@@ -283,6 +311,14 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
             "fleet_losses": st["replicas"]["losses"],
             "fleet_rejoins": st["replicas"]["rejoins"],
             "fleet_requests": n_requests,
+            # The pooled-path evidence, measured THROUGH the kill:
+            # router-side channel reuse over the whole run (pinned min —
+            # connect-per-request would read ~0), the churn breakdown,
+            # and the client generator's own reconnect count.
+            "fleet_conn_reuse_ratio": st["pool"]["reuse_ratio"],
+            "fleet_conns_opened": st["pool"]["opened"],
+            "fleet_conns_retired": sum(st["pool"]["retired"].values()),
+            "fleet_client_reconnects": stats["reconnects"],
         }
     finally:
         if srv is not None:

@@ -1,0 +1,341 @@
+"""The fleet's persistent-connection layer: one pool, every hop.
+
+PR 14's data plane opened a fresh TCP connection for every forwarded
+request, every ``/healthz`` probe, and every load-generator request — at
+the measured serving rates the handshake churn, not the model, bounds
+fleet latency for small voxel payloads. This module is the one place in
+the package allowed to construct ``http.client.HTTPConnection``
+(``analysis.rules`` raw-conn lint); everything else checks a channel out
+of a pool and puts it back.
+
+Pool contract:
+
+- **Check-out / check-in**: ``checkout(host, port)`` hands back an idle
+  keep-alive channel for that endpoint (or opens a fresh one); the
+  caller owns it exclusively until ``checkin``. One pool serves many
+  threads — the router's request threads and the manager's probe
+  threads share channels to the same replica.
+- **Bounded idle**: at most ``max_idle_per_endpoint`` channels are kept
+  per endpoint; extras are retired on check-in (``idle_overflow``), so
+  a burst's connection fan never lingers as open sockets.
+- **Max-age retirement**: a channel older than ``max_age_s`` is retired
+  instead of reused (``max_age``) — long-lived sockets quietly
+  accumulate middlebox state; bounded age keeps the pool honest about
+  what a "fresh" connection costs (``connect_ms`` keeps measuring).
+- **Broken-socket detection**: a channel that dies mid-request is
+  retired (``broken``), never re-pooled. ``post`` additionally retries
+  ONCE on a *fresh* channel when the failure happened on a REUSED one —
+  a keep-alive peer is allowed to close an idle connection between
+  requests (a stale channel on a healthy replica), and surfacing that
+  as a replica failure would burn the router's one re-submit on a
+  replica that never misbehaved. A fresh channel failing is the real
+  replica-loss shape and raises to the caller, so the router's
+  re-submit-once + zero-drop semantics are exactly what they were.
+- **Health coupling**: ``retire_endpoint`` drops every idle channel for
+  an endpoint NOW — called when a probe fails or a replica is charged
+  lost, so the next forward starts clean instead of discovering the
+  corpse socket itself.
+
+Telemetry (never load-bearing): ``conn_open`` / ``conn_reuse`` /
+``conn_retire{reason}`` events land in the run stream (the report's
+serve/fleet sections and ``/metrics`` count them), and each fresh
+connect feeds the ``connect_ms`` rolling window — the number that
+proves pooling pays. The pool also keeps plain counters (``stats()``)
+so ``bench_fleet`` can pin the reuse ratio with no sink installed.
+
+Stdlib-only, like the rest of the fleet package: the pool lives in the
+router/manager process, which owns no device and must survive every
+replica.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from featurenet_tpu import obs
+from featurenet_tpu.obs import windows as _windows
+
+# Idle bound: sized to the load generator's worker-pool concurrency (32)
+# so a healthy burst's whole fan can come back to the idle set instead of
+# churning through idle_overflow retirement; the bound exists for the
+# pathological fan (a stampede), not the steady state.
+DEFAULT_MAX_IDLE_PER_ENDPOINT = 32
+DEFAULT_MAX_AGE_S = 60.0
+DEFAULT_TIMEOUT_S = 60.0
+
+# Retirement reasons (the conn_retire event's vocabulary — closed set so
+# the report/metrics fold never meets a free-form string).
+RETIRE_REASONS = ("broken", "max_age", "idle_overflow", "server_close",
+                  "probe_failure", "replica_loss", "shutdown")
+
+
+class PooledChannel:
+    """One keep-alive channel: the raw connection plus the bookkeeping
+    the retirement policies need (endpoint identity, birth time, use
+    count). Owned exclusively by one caller between checkout/checkin."""
+
+    __slots__ = ("conn", "host", "port", "opened_t", "uses")
+
+    def __init__(self, conn: http.client.HTTPConnection, host: str,
+                 port: int):
+        self.conn = conn
+        self.host = host
+        self.port = port
+        self.opened_t = time.monotonic()
+        self.uses = 0
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def age_s(self) -> float:
+        return time.monotonic() - self.opened_t
+
+
+class ConnectionPool:
+    """Bounded keep-alive channel pool over ``(host, port)`` endpoints
+    (see the module doc for the full contract)."""
+
+    def __init__(self,
+                 max_idle_per_endpoint: int = DEFAULT_MAX_IDLE_PER_ENDPOINT,
+                 max_age_s: float = DEFAULT_MAX_AGE_S,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        if max_idle_per_endpoint < 1:
+            raise ValueError(
+                f"max_idle_per_endpoint must be >= 1, "
+                f"got {max_idle_per_endpoint}"
+            )
+        if max_age_s <= 0:
+            raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
+        self.max_idle_per_endpoint = int(max_idle_per_endpoint)
+        self.max_age_s = float(max_age_s)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._idle: dict[tuple[str, int], deque[PooledChannel]] = {}
+        self._closed = False
+        self._opened = 0
+        self._reused = 0
+        self._in_use = 0
+        self._in_use_peak = 0
+        self._retired: dict[str, int] = {}
+
+    # -- core check-out / check-in --------------------------------------------
+    def checkout(self, host: str, port: int,
+                 timeout_s: Optional[float] = None,
+                 fresh: bool = False) -> PooledChannel:
+        """An exclusive channel to ``host:port``: the freshest idle one
+        (max-age violators retired on the way), else a new connection.
+        ``timeout_s`` re-arms the socket timeout per use — probes and
+        forwards share channels but not deadlines. ``fresh=True`` skips
+        the idle set entirely (the stale-reuse retry must not inherit a
+        sibling channel the same peer close already killed)."""
+        with self._lock:
+            if self._closed:
+                # A closed pool must not silently degrade to
+                # connect-per-request churn: refuse like a dead endpoint
+                # (OSError — every caller's failure policy already
+                # handles the connection-failure shape).
+                raise OSError("connection pool is closed")
+        timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        key = (host, int(port))
+        ch: Optional[PooledChannel] = None
+        while not fresh:
+            with self._lock:
+                q = self._idle.get(key)
+                cand = q.pop() if q else None
+            if cand is None:
+                break
+            if cand.age_s() > self.max_age_s or cand.conn.sock is None:
+                self._retire(cand, "max_age" if cand.conn.sock is not None
+                             else "server_close")
+                continue
+            ch = cand
+            break
+        if ch is None:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+            t0 = time.perf_counter()
+            conn.connect()
+            connect_ms = (time.perf_counter() - t0) * 1e3
+            ch = PooledChannel(conn, host, int(port))
+            with self._lock:
+                self._opened += 1
+            _windows.observe("connect_ms", connect_ms)
+            obs.emit("conn_open", endpoint=ch.endpoint,
+                     connect_ms=round(connect_ms, 3))
+        else:
+            with self._lock:
+                self._reused += 1
+            if ch.conn.sock is not None:
+                ch.conn.sock.settimeout(timeout)
+            obs.emit("conn_reuse", endpoint=ch.endpoint, uses=ch.uses)
+        ch.uses += 1
+        with self._lock:
+            self._in_use += 1
+            self._in_use_peak = max(self._in_use_peak, self._in_use)
+        return ch
+
+    def checkin(self, ch: PooledChannel) -> None:
+        """Return a still-healthy channel to the idle set; channels past
+        max-age, already closed, or over the idle bound are retired
+        instead (the bound keeps a burst's fan from lingering)."""
+        with self._lock:
+            self._in_use = max(0, self._in_use - 1)
+        if ch.conn.sock is None:
+            self._retire(ch, "server_close")
+            return
+        if ch.age_s() > self.max_age_s:
+            self._retire(ch, "max_age")
+            return
+        key = (ch.host, ch.port)
+        with self._lock:
+            if not self._closed:
+                q = self._idle.setdefault(key, deque())
+                if len(q) < self.max_idle_per_endpoint:
+                    q.append(ch)
+                    return
+        self._retire(ch, "idle_overflow" if not self._closed
+                     else "shutdown")
+
+    def _retire(self, ch: PooledChannel, reason: str) -> None:
+        try:
+            ch.conn.close()
+        except OSError:
+            pass
+        with self._lock:
+            self._retired[reason] = self._retired.get(reason, 0) + 1
+        obs.emit("conn_retire", endpoint=ch.endpoint, reason=reason,
+                 uses=ch.uses)
+
+    def retire(self, ch: PooledChannel, reason: str = "broken") -> None:
+        """Retire a checked-out channel (a caller saw it break)."""
+        with self._lock:
+            self._in_use = max(0, self._in_use - 1)
+        self._retire(ch, reason)
+
+    def retire_endpoint(self, host: str, port: int,
+                        reason: str = "probe_failure") -> int:
+        """Drop every IDLE channel for an endpoint now (probe failure,
+        replica charged lost) — the next checkout starts clean instead
+        of inheriting a corpse socket. Returns the count retired."""
+        key = (host, int(port))
+        with self._lock:
+            q = self._idle.pop(key, None)
+        if not q:
+            return 0
+        for ch in q:
+            self._retire(ch, reason)
+        return len(q)
+
+    def close(self) -> None:
+        """Retire every idle channel (``shutdown``); later check-ins are
+        retired instead of pooled. Checked-out channels stay valid until
+        their owners return them."""
+        with self._lock:
+            self._closed = True
+            qs = list(self._idle.values())
+            self._idle.clear()
+        for q in qs:
+            for ch in q:
+                self._retire(ch, "shutdown")
+
+    # -- request helpers (the package's ONLY wire hops) ------------------------
+    def post(self, host: str, port: int, path: str, body: bytes,
+             headers: dict, timeout_s: Optional[float] = None
+             ) -> tuple[int, bytes, Optional[float]]:
+        """One pooled HTTP POST (the router's forward AND the fleet load
+        generator's request — one implementation, so Retry-After parsing
+        and header handling can never drift). Returns ``(status,
+        body_bytes, retry_after_s)``. A REUSED channel that breaks is
+        retired and retried once on a fresh connection (stale keep-alive
+        ≠ dead replica); a fresh channel's failure raises ``OSError`` /
+        ``http.client.HTTPException`` upward — the replica-loss shape
+        the router's re-submit-once path absorbs."""
+        return self._request(host, port, "POST", path, body, headers,
+                             timeout_s)
+
+    def get(self, host: str, port: int, path: str,
+            timeout_s: Optional[float] = None) -> tuple[int, bytes]:
+        """One pooled HTTP GET (the ``/healthz`` probe hop). Same stale-
+        reuse retry as ``post``; raises on a fresh channel's failure."""
+        status, data, _ = self._request(host, port, "GET", path, None,
+                                        {}, timeout_s)
+        return status, data
+
+    def _request(self, host: str, port: int, method: str, path: str,
+                 body: Optional[bytes], headers: dict,
+                 timeout_s: Optional[float]
+                 ) -> tuple[int, bytes, Optional[float]]:
+        """The one checkout → roundtrip → stale-retry → checkin state
+        machine behind ``post`` and ``get`` (a retry-rule change must
+        apply to forwards and probes together, never drift)."""
+        force_fresh = False
+        while True:
+            ch = self.checkout(host, port, timeout_s, fresh=force_fresh)
+            reused = ch.uses > 1
+            try:
+                status, data, ra = self._roundtrip(
+                    ch, method, path, body, headers
+                )
+            except (OSError, http.client.HTTPException) as e:
+                self.retire(ch, "broken")
+                # A TIMEOUT is not a stale channel: the peer is alive
+                # but slow (an admitted request still running) — a
+                # silent re-send would duplicate work on an overloaded
+                # endpoint and block the caller for a second full
+                # timeout. Raise it to the caller's own failure policy.
+                if isinstance(e, TimeoutError):
+                    raise
+                if reused and not force_fresh:
+                    # The peer closed a keep-alive channel between
+                    # requests; a FRESH connection decides whether the
+                    # endpoint is actually gone.
+                    force_fresh = True
+                    continue
+                raise
+            self.checkin(ch)
+            return status, data, ra
+
+    @staticmethod
+    def _roundtrip(ch: PooledChannel, method: str, path: str,
+                   body: Optional[bytes], headers: dict
+                   ) -> tuple[int, bytes, Optional[float]]:
+        hdrs = dict(headers)
+        if body is not None:
+            hdrs.setdefault("Content-Type", "application/octet-stream")
+        ch.conn.request(method, path, body=body, headers=hdrs)
+        resp = ch.conn.getresponse()
+        data = resp.read()  # fully drained: the channel is reusable
+        ra = resp.getheader("Retry-After")
+        try:
+            ra = float(ra) if ra is not None else None
+        except ValueError:
+            ra = None
+        if resp.will_close:
+            # The server said this was the channel's last response
+            # (Connection: close — e.g. a draining 503): honor it.
+            ch.conn.close()
+        return resp.status, data, ra
+
+    # -- introspection ---------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            opened, reused = self._opened, self._reused
+            total = opened + reused
+            return {
+                "opened": opened,
+                "reused": reused,
+                "reuse_ratio": round(reused / total, 4) if total else None,
+                "retired": dict(sorted(self._retired.items())),
+                "idle": sum(len(q) for q in self._idle.values()),
+                "in_use": self._in_use,
+                "in_use_peak": self._in_use_peak,
+                # Client-side churn: fresh connects beyond the working
+                # set a caller's concurrency needed anyway — each one is
+                # a channel that had to be REopened (retirement, broken
+                # socket), which is exactly what pooling exists to avoid.
+                "reconnects": max(0, opened - self._in_use_peak),
+            }

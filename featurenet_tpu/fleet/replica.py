@@ -18,7 +18,12 @@ their whole lifecycle with the coordinator's supervision shape
 - **Readiness**: the manager probes each replica's ``/healthz`` every
   poll; a replica routes traffic only while its probe answers 200
   (ready), and the probed ``queue_depth`` feeds the router's
-  least-queue-depth pick. Loss → respawn (with crash-loop backoff) →
+  least-queue-depth pick. Probes ride the manager's connection pool
+  (``fleet.pool`` — the same pool the router forwards on), so a poll
+  cycle reuses a warm channel instead of opening a socket; a probe
+  FAILURE retires that endpoint's pooled channels immediately, so the
+  next forward starts on a fresh connection instead of discovering the
+  corpse itself. Loss → respawn (with crash-loop backoff) →
   the respawned child warms its bucket ladder from the fleet-shared
   exec cache → rejoins the roster ONLY when ``/healthz`` turns ready.
 - **Roster**: every ready/loss transition rewrites ``membership.json``
@@ -46,6 +51,7 @@ from typing import Callable, Optional
 from featurenet_tpu import faults, obs
 from featurenet_tpu.elastic.coordinator import heartbeat_path
 from featurenet_tpu.elastic.membership import Membership, write_membership
+from featurenet_tpu.fleet.pool import ConnectionPool
 from featurenet_tpu.train.heartbeat import HeartbeatMonitor
 from featurenet_tpu.train.supervisor import _kill_tree
 
@@ -111,7 +117,8 @@ class ReplicaManager:
                  backoff_base_s: float = 0.5,
                  backoff_cap_s: float = 10.0,
                  probe_timeout_s: float = 2.0,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 pool: Optional[ConnectionPool] = None):
         if n < 1:
             raise ValueError(f"replicas must be >= 1, got {n}")
         self.n = n
@@ -127,6 +134,11 @@ class ReplicaManager:
         self.backoff_cap_s = backoff_cap_s
         self.probe_timeout_s = probe_timeout_s
         self.env = env
+        # The fleet's one channel pool: probes ride it here, forwards
+        # ride it in the router (FleetRouter adopts the provider's pool
+        # via this attribute), so health verdicts and traffic share the
+        # same view of which channels are alive.
+        self.pool = pool or ConnectionPool()
         self._lock = threading.Lock()
         self._replicas = {slot: _Replica(slot) for slot in range(n)}
         self._spawns = 0
@@ -165,6 +177,7 @@ class ReplicaManager:
                 p.wait(timeout=max(0.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 _kill_tree(p)
+        self.pool.close()
 
     # -- spawn / supervision --------------------------------------------------
     def _spawn(self, r: _Replica) -> None:
@@ -220,34 +233,48 @@ class ReplicaManager:
                 continue  # not this child's banner; keep scanning
         return None
 
-    def _probe(self, r: _Replica) -> Optional[dict]:
-        """One ``/healthz`` probe: the parsed body on HTTP 200, None on
-        anything else (503 warming/draining, connection refused, hung
-        socket) — "not routable right now", with the kill decision left
-        to the heartbeat/exit machinery."""
-        import urllib.error
-        import urllib.request
+    def _probe(self, port: int) -> Optional[dict]:
+        """One pooled ``/healthz`` probe: the parsed body on HTTP 200,
+        None on anything else (503 warming/draining, connection refused,
+        hung socket) — "not routable right now", with the kill decision
+        left to the heartbeat/exit machinery. Rides the shared channel
+        pool, so steady-state polling costs zero handshakes. Takes the
+        port the caller CAPTURED (not ``r.port``, which the tick thread
+        nulls on loss while a probe is in flight).
 
-        url = f"http://{self.host}:{r.port}/healthz"
+        Retirement discipline: only a CONNECTION-level failure retires
+        the endpoint's pooled channels (the corpse-socket signal). A
+        clean non-200 — a warming or draining replica answering 503 —
+        arrived over a perfectly healthy channel; retiring it would be
+        one handshake per poll cycle for the whole warmup, exactly the
+        churn the pool exists to remove."""
+        import http.client
+
         try:
-            with urllib.request.urlopen(
-                url, timeout=self.probe_timeout_s
-            ) as resp:
-                return json.loads(resp.read().decode("utf-8"))
-        except urllib.error.HTTPError as e:
-            e.close()
-            return None
-        except (OSError, ValueError):
+            status, body = self.pool.get(
+                self.host, port, "/healthz",
+                timeout_s=self.probe_timeout_s,
+            )
+            if status != 200:
+                return None
+            return json.loads(body.decode("utf-8"))
+        except (OSError, http.client.HTTPException, ValueError):
+            self.pool.retire_endpoint(self.host, port, "probe_failure")
             return None
 
     def _lose(self, r: _Replica, reason: str) -> None:
         if r.proc is not None and r.proc.poll() is None:
             _kill_tree(r.proc)
         was_ready = r.ready
+        port = r.port
         with self._lock:
             r.proc = None
             r.port = None
             r.ready = False
+        if port is not None:
+            # A lost replica's channels are corpse sockets: retire them
+            # NOW so no forward (or probe) inherits one.
+            self.pool.retire_endpoint(self.host, port, "replica_loss")
         r.was_lost = True
         r.failures += 1
         self._losses += 1
@@ -302,12 +329,16 @@ class ReplicaManager:
             port = r.port
             if port is None or r.proc is None:
                 return
-            health = self._probe(r)
+            health = self._probe(port)
             if health is None:
-                # Not routable (warming, draining, or a transient probe
-                # failure): gate it out of the candidate set but leave
-                # the kill verdict to the heartbeat — probing through
-                # one dropped packet must not cost a respawn.
+                # Not routable (warming, draining, or a probe failure):
+                # gate it out of the candidate set but leave the kill
+                # verdict to the heartbeat — probing through one dropped
+                # packet must not cost a respawn. (_probe itself retires
+                # the endpoint's channels when the failure was
+                # connection-level — the earliest stale-channel signal —
+                # and leaves them pooled on a clean warming/draining
+                # 503.)
                 with self._lock:
                     r.ready = False
                 return

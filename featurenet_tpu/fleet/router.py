@@ -21,6 +21,16 @@ Routing policy, in the order a request experiences it:
    connection death is an honest drop (502, counted in
    ``fleet_requests_dropped`` — the number the gate pins at 0).
 
+Every router→replica hop rides the connection pool (``fleet.pool``):
+forwards check a keep-alive channel out per request instead of paying a
+TCP handshake, a broken channel is retired on the spot (a stale
+keep-alive reuse retries once on a FRESH connection inside the pool, so
+only a genuinely dead replica reaches the re-submit path), and the
+manager's ``/healthz`` probes share the same pool — a probe failure
+retires that endpoint's channels immediately instead of letting the
+next forward discover the corpse socket. The front end itself speaks
+HTTP/1.1 keep-alive, so the client side of the hop persists too.
+
 Scaling verdicts are advisory, never load-bearing: the router feeds its
 end-to-end walls into the rolling ``serving_ms`` window (the SAME alert
 machinery every service runs) and a background cycle turns the window
@@ -42,6 +52,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
 from featurenet_tpu import faults, obs
+from featurenet_tpu.fleet.pool import ConnectionPool
 from featurenet_tpu.obs import alerts as _alerts
 from featurenet_tpu.obs import windows as _windows
 from featurenet_tpu.obs.tracing import TRACE_HEADER, normalize_trace_id
@@ -54,37 +65,12 @@ DEFAULT_RETRY_AFTER_S = 0.25
 DEFAULT_SCALE_EVERY_S = 5.0
 
 _ENDPOINTS = ["POST /predict", "POST /predict_voxels", "GET /stats",
-              "GET /healthz"]
+              "GET /healthz", "GET /metrics"]
 
 # Queue depth (mean over ready replicas) above which the scale verdict
 # says "add" even while the p99 still holds — pressure building is the
 # earlier signal.
 _SCALE_ADD_DEPTH = 8.0
-
-
-def post_once(host: str, port: int, path: str, body: bytes,
-              headers: dict, timeout_s: float):
-    """One HTTP POST hop (the router's forward AND the fleet load
-    generator's request — one implementation, so Retry-After parsing
-    and header handling can never drift between the two). Returns
-    ``(status, body_bytes, retry_after_s)``; connection-level failures
-    raise ``OSError`` / ``http.client.HTTPException`` upward."""
-    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
-    try:
-        conn.request("POST", path, body=body, headers={
-            "Content-Type": "application/octet-stream",
-            **headers,
-        })
-        resp = conn.getresponse()
-        data = resp.read()
-        ra = resp.getheader("Retry-After")
-        try:
-            ra = float(ra) if ra is not None else None
-        except ValueError:
-            ra = None
-        return resp.status, data, ra
-    finally:
-        conn.close()
 
 
 def scale_verdict(p99_ms: Optional[float], queue_depth: float,
@@ -126,6 +112,14 @@ class FleetRouter:
         self.retry_after_s = float(retry_after_s)
         self.request_timeout_s = float(request_timeout_s)
         self.scale_every_s = float(scale_every_s)
+        # Forwards ride the replica provider's pool when it has one
+        # (ReplicaManager owns it so /healthz probes share channels with
+        # forwards); a bare provider (tests) gets the router's own. Only
+        # a pool the router CONSTRUCTED is the router's to close — the
+        # manager's outlives the router's drain (its probes still run).
+        shared = getattr(fleet, "pool", None)
+        self._own_pool = shared is None
+        self.pool: ConnectionPool = shared or ConnectionPool()
         self._lock = threading.Lock()
         self._routed = 0
         self._answered = 0
@@ -170,10 +164,12 @@ class FleetRouter:
     # -- the routing core -----------------------------------------------------
     def _forward(self, cand, path: str, body: bytes, trace_id: str,
                  lane: str):
-        """One hop to one replica. Returns ``(status, body_bytes,
-        retry_after_s)``; raises ``OSError`` / ``HTTPException`` when
-        the connection dies (the replica-loss shape)."""
-        return post_once(
+        """One pooled hop to one replica. Returns ``(status, body_bytes,
+        retry_after_s)``; raises ``OSError`` / ``HTTPException`` only
+        when a FRESH connection fails (the pool absorbs stale keep-alive
+        channels itself) — the replica-loss shape the re-submit path
+        absorbs."""
+        return self.pool.post(
             cand.host, cand.port, path, body,
             {TRACE_HEADER: trace_id, PRIORITY_HEADER: lane},
             self.request_timeout_s,
@@ -190,6 +186,10 @@ class FleetRouter:
         with self._lock:
             if self._draining:
                 headers["Retry-After"] = f"{self.retry_after_s:.3f}"
+                # The keep-alive hangup marker: the front end sends this
+                # header through, which also closes the channel — a
+                # draining fleet must not keep clients parked on it.
+                headers["Connection"] = "close"
                 return 503, json.dumps(
                     {"error": "draining", "fleet": True}
                 ).encode(), headers
@@ -258,6 +258,11 @@ class FleetRouter:
                 )
             except (OSError, http.client.HTTPException):
                 self.fleet.note_failure(cand.slot)
+                # The channel that died is already retired (pool.post);
+                # drop the endpoint's remaining IDLE channels too — a
+                # dead replica's whole channel set is corpse sockets.
+                self.pool.retire_endpoint(cand.host, cand.port,
+                                          "replica_loss")
                 if failed_once:
                     # Re-submit ONCE: a second replica dying under the
                     # same request is an honest drop, not a retry loop.
@@ -302,11 +307,21 @@ class FleetRouter:
         router = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive front end: HTTP/1.1 + exact Content-Length on
+            # every response, mirroring the replica servers — a client
+            # (or upstream balancer) holds one warm channel to the
+            # fleet instead of re-handshaking per request.
+            protocol_version = "HTTP/1.1"
+            timeout = router.request_timeout_s + 15.0
+
             def log_message(self, fmt, *args):  # noqa: N802
                 pass
 
             def _send(self, code: int, body: bytes,
                       headers: dict) -> None:
+                # A "Connection: close" in headers (the draining 503's
+                # hangup marker, set by route()) also flips the stdlib
+                # close_connection flag via send_header.
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -332,18 +347,33 @@ class FleetRouter:
                     ).encode()
                     self._send(200, body, {})
                     return
+                if self.path == "/metrics":
+                    from featurenet_tpu.serve.metrics import (
+                        CONTENT_TYPE,
+                        render_router_metrics,
+                    )
+
+                    body = render_router_metrics(router).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._send(404, json.dumps({
                     "error": "not_found", "endpoints": _ENDPOINTS,
                 }).encode(), {})
 
             def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
                 if self.path not in ("/predict", "/predict_voxels"):
+                    # Body already drained above: an unread body on a
+                    # keep-alive channel would desync the NEXT request.
                     self._send(404, json.dumps({
                         "error": "not_found", "endpoints": _ENDPOINTS,
                     }).encode(), {})
                     return
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length)
                 status, data, headers = router.route(
                     self.path, body,
                     trace_id=self.headers.get(TRACE_HEADER),
@@ -358,7 +388,7 @@ class FleetRouter:
     # -- introspection / lifecycle --------------------------------------------
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "routed": self._routed,
                 "answered": self._answered,
                 "rejected": self._rejected,
@@ -368,6 +398,10 @@ class FleetRouter:
                 "dropped": self._dropped,
                 "replicas": self.fleet.stats(),
             }
+        # Channel-churn evidence (opened/reused/retired{reason}): the
+        # pooling payoff, read by bench_fleet's reuse-ratio pin.
+        out["pool"] = self.pool.stats()
+        return out
 
     def drain(self) -> dict:
         """Stop routing, flush the final window cycle, report the fleet
@@ -381,6 +415,12 @@ class FleetRouter:
         self._scale_thread.join(timeout=2.0)
         _windows.flush()
         st = self.stats()
+        # Retire the idle channel set — but only a pool the router
+        # constructed: closing the manager's shared pool here would
+        # turn its still-running probes into connect-and-refuse churn
+        # (ReplicaManager.stop closes that one when supervision ends).
+        if self._own_pool:
+            self.pool.close()
         active = [m for m in _windows.active_alerts()
                   if _alerts.is_serving_metric(m)]
         st["active_serving_alerts"] = active

@@ -61,6 +61,10 @@ WINDOW_METRICS = (
                         # enqueue→response interval per request)
     "queue_wait_ms",    # serving front end: request enqueue→dispatch wait
                         # (admission pressure building before latency blows)
+    "connect_ms",       # fleet.pool: TCP connect wall per FRESH channel —
+                        # the handshake cost pooling exists to amortize; a
+                        # pool that stops reusing shows up here as volume
+                        # (count climbing), not just latency
     "mfu",              # per-dispatch model-flops utilization (obs.perf:
                         # compiled flops over wall over the device-kind
                         # peak; no samples on the `unknown` peak tier)

@@ -24,7 +24,10 @@ _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 # (artifact key, column header, format) — the columns worth reading
 # round-over-round. Keys absent from a round render as "—" (older
-# schemas simply had fewer fields).
+# schemas simply had fewer fields). The fleet_* block is the serving-
+# fleet trajectory (PR 14's pins plus PR 15's pooled-path reuse ratio)
+# reading alongside sps/MFU, so a fleet regression is visible in the
+# same table as a training one.
 _COLUMNS = (
     ("value", "sps/chip", "{:.0f}"),
     ("mfu", "mfu", "{:.2f}"),
@@ -34,6 +37,10 @@ _COLUMNS = (
     ("serve_p99_ms", "p99_ms", "{:.1f}"),
     ("ttfs_warm_s", "ttfs_w", "{:.1f}"),
     ("trace_overhead_pct", "trace_%", "{:.1f}"),
+    ("fleet_qps_sustained", "qps_fleet", "{:.0f}"),
+    ("fleet_p99_ms", "fl_p99", "{:.1f}"),
+    ("fleet_requests_dropped", "fl_drop", "{:.0f}"),
+    ("fleet_conn_reuse_ratio", "fl_reuse", "{:.2f}"),
 )
 
 

@@ -150,6 +150,11 @@ DIRECTIONS = {
     "fleet_qps_sustained": "min",
     "fleet_p99_ms": "max",
     "fleet_requests_dropped": "max",
+    # Persistent-connection data plane (fleet.pool): router-side channel
+    # reuse over the whole bench_fleet run, measured THROUGH the kill.
+    # Regresses DOWNWARD — a ratio sliding toward 0 is the data plane
+    # rotting back to connect-per-request (the PR-15 gap reopening).
+    "fleet_conn_reuse_ratio": "min",
 }
 
 
@@ -270,6 +275,7 @@ BENCH_GATE_KEYS = (
     "fleet_qps_sustained",
     "fleet_p99_ms",
     "fleet_requests_dropped",
+    "fleet_conn_reuse_ratio",
 )
 
 

@@ -810,6 +810,35 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             serve["rejected"] = stops[-1].get("rejected")
         rep["serve"] = serve
 
+    # --- persistent-connection data plane (fleet.pool) ------------------------
+    # Channel lifecycle events, merged across streams: opened vs reused
+    # is the pooling payoff (reuse_ratio — the bench gate pins the fleet
+    # flavor), retired-by-reason is the churn story (a spike of "broken"
+    # is replica loss; "max_age"/"idle_overflow" is policy working as
+    # designed). Surfaced top-level and mirrored into the serve/fleet
+    # sections so the fold reads next to the traffic it carried.
+    conn_ev = [e for e in events
+               if e["ev"] in ("conn_open", "conn_reuse", "conn_retire")]
+    connections = None
+    if conn_ev:
+        retired: dict[str, int] = {}
+        for e in conn_ev:
+            if e["ev"] == "conn_retire":
+                reason = str(e.get("reason", "?"))
+                retired[reason] = retired.get(reason, 0) + 1
+        opened = sum(e["ev"] == "conn_open" for e in conn_ev)
+        reused = sum(e["ev"] == "conn_reuse" for e in conn_ev)
+        connections = {
+            "opened": opened,
+            "reused": reused,
+            "reuse_ratio": round(reused / (opened + reused), 4)
+            if (opened + reused) else None,
+            "retired": dict(sorted(retired.items())),
+        }
+        rep["connections"] = connections
+        if rep.get("serve") is not None:
+            rep["serve"]["connections"] = connections
+
     # --- serving fleet (featurenet_tpu.fleet) --------------------------------
     # Roster transitions + routing outcomes, merged across every stream
     # (the router owns stream 0; each replica writes its own). The
@@ -857,6 +886,8 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             fleet["answered"] = stops[-1].get("answered")
             fleet["rejected"] = stops[-1].get("rejected")
             fleet["dropped"] = stops[-1].get("dropped")
+        if connections is not None:
+            fleet["connections"] = connections
         rep["fleet"] = fleet
 
     # --- request-level traces (obs.tracing) ----------------------------------
@@ -1181,6 +1212,18 @@ def format_report(rep: dict) -> str:
             detail = {k: v for k, v in e.items()
                       if k not in ("t", "event")}
             lines.append(f"  t={e['t']:.3f} {e['event']} {detail or ''}")
+    cn = rep.get("connections")
+    if cn:
+        ratio = cn.get("reuse_ratio")
+        lines.append(
+            f"connections: {cn['opened']} opened, "
+            f"{cn['reused']} reused"
+            + (f" (reuse {ratio * 100:.1f}%)"
+               if ratio is not None else "")
+            + (", retired " + ", ".join(
+                f"{k}×{v}" for k, v in cn["retired"].items()
+               ) if cn.get("retired") else "")
+        )
     tr = rep.get("traces")
     if tr:
         lines.append(
@@ -1466,6 +1509,12 @@ KNOWN_EVENT_KINDS = frozenset({
     "fleet_start", "fleet_replica_ready", "fleet_replica_loss",
     "fleet_spillover", "fleet_resubmit", "fleet_shed", "fleet_scale",
     "fleet_stop",
+    # Persistent-connection data plane (fleet.pool): a fresh channel
+    # opened (carrying its connect_ms — the handshake cost pooling
+    # amortizes), an idle keep-alive channel reused, and a channel
+    # retired with its reason (broken / max_age / idle_overflow /
+    # server_close / probe_failure / replica_loss / shutdown).
+    "conn_open", "conn_reuse", "conn_retire",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -1511,6 +1560,9 @@ REQUIRED_EVENT_FIELDS = {
     "fleet_shed": ("lane",),
     "fleet_scale": ("verdict",),
     "fleet_stop": ("routed", "dropped"),
+    "conn_open": ("endpoint",),
+    "conn_reuse": ("endpoint",),
+    "conn_retire": ("endpoint", "reason"),
 }
 
 # The event kinds that carry a per-request ``trace`` id — the timeline

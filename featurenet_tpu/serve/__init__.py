@@ -6,10 +6,13 @@ executables, with admission control and SLO-gated latency.
                  de-mux, overload fast-reject.
 - ``service``  — the ladder of pre-built ``serve`` executables + the STL
                  upload path + SLO-gated drain (``InferenceService``).
-- ``http``     — stdlib HTTP front end (``POST /predict`` with STL
-                 bytes, ``GET /stats``).
-- ``loadgen``  — Poisson open-loop load generator; ``bench_serving`` is
-                 bench.py's sustained-QPS / p50/p99 / occupancy row.
+- ``http``     — stdlib HTTP/1.1 keep-alive front end (``POST
+                 /predict`` with STL bytes, ``POST
+                 /predict_voxels_stream`` pipelining length-prefixed
+                 voxel frames over one socket, ``GET /stats``).
+- ``loadgen``  — Poisson open-loop load generator (``bench_serving`` is
+                 bench.py's sustained-QPS / p50/p99 / occupancy row) and
+                 ``stream_load``, the single-socket stream client.
 
 Entry point: ``python -m featurenet_tpu.cli serve --checkpoint-dir D``.
 """

@@ -21,6 +21,31 @@ server-side — the fleet load generator's path). Both POST endpoints read
 the ``X-Featurenet-Priority`` header (``interactive`` default /
 ``batch``): batch rides the shed-first lane of the batcher's admission.
 
+``POST /predict_voxels_stream`` is the batched sibling: FeatureNet's
+real unit of work is a corpus of parts, not a singleton, and a
+part-per-request protocol pays one round trip per part. The stream body
+is a sequence of length-prefixed frames — ``<u32 little-endian payload
+length><payload>`` repeated, each payload one ``/predict_voxels`` grid —
+under one ``Content-Length``; the response streams back one JSON line
+per frame (chunked transfer) in frame order as each resolves, so a
+client pipelines hundreds of parts over ONE socket instead of hundreds
+of handshakes. Frames fan into the continuous batcher as independent
+lane-tagged requests, each with its own trace id tied to the stream id
+(``<stream>.<frame>``); a per-frame overload/timeout/forward error is a
+structured error LINE for that frame, never a dropped stream. A torn
+frame (truncated prefix or short payload) is a structured 400 — the
+byte stream is unreliable past that point, so the connection closes.
+
+**Keep-alive contract.** The server speaks ``HTTP/1.1``: every response
+carries an exact ``Content-Length`` (or chunked framing, for the stream
+endpoint), so one connection serves any number of sequential requests —
+the connection-churn half of fleet latency at small payloads. The
+server closes a connection in exactly two cases: a *draining* 503 (the
+service is going away; ``Connection: close`` tells the pool to retire
+the channel, not retry it) and a torn stream. Overload 503s keep the
+connection open — the rejection is transient and the polite retry
+should ride the warm channel.
+
 Trace propagation: a caller-supplied ``X-Featurenet-Trace`` request
 header is adopted as the request's trace id (``obs.tracing``) and echoed
 back on EVERY ``/predict`` response — 200s, overload 503s, even 400s —
@@ -48,9 +73,15 @@ shape.
 from __future__ import annotations
 
 import json
+import struct
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from featurenet_tpu.obs.tracing import TRACE_HEADER, normalize_trace_id
+from featurenet_tpu.obs.tracing import (
+    TRACE_HEADER,
+    mint_trace_id,
+    normalize_trace_id,
+)
 from featurenet_tpu.serve.batcher import OverloadError, normalize_lane
 
 DEFAULT_REQUEST_TIMEOUT_S = 60.0
@@ -60,8 +91,14 @@ DEFAULT_REQUEST_TIMEOUT_S = 60.0
 # priority must never be treated as shed-first bulk.
 PRIORITY_HEADER = "X-Featurenet-Priority"
 
-_ENDPOINTS = ["POST /predict", "POST /predict_voxels", "GET /stats",
+_ENDPOINTS = ["POST /predict", "POST /predict_voxels",
+              "POST /predict_voxels_stream", "GET /stats",
               "GET /healthz", "GET /metrics"]
+
+# A frame trace id is "<stream>.<frame index>" and must still satisfy
+# the trace-id grammar (≤64 chars): adopt the caller's stream id only
+# when the suffixed form is guaranteed to fit, else mint (16 hex chars).
+_MAX_STREAM_ID_LEN = 48
 
 
 def _parse_voxels(data: bytes, resolution: int):
@@ -90,12 +127,22 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
     ``shutdown()`` before draining the service."""
 
     class Handler(BaseHTTPRequestHandler):
+        # Keep-alive by default: HTTP/1.1 + exact Content-Length on
+        # every response means the connection outlives the request —
+        # the pool/loadgen reuse it instead of re-handshaking.
+        protocol_version = "HTTP/1.1"
+        # Socket deadline: bounds how long an idle keep-alive channel
+        # may park a handler thread (the pool's max-age retires its side
+        # well before this; a slow client mid-upload hits it too).
+        timeout = request_timeout_s + 15.0
+
         def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
             pass  # access logging is the obs layer's job, not stderr's
 
         def _json(self, code: int, payload: dict,
                   trace_id: str | None = None,
-                  retry_after_s: float | None = None) -> None:
+                  retry_after_s: float | None = None,
+                  close: bool = False) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -111,6 +158,12 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                 # router — parse float; integer-only parsers read the
                 # leading digits, still a sane backoff).
                 self.send_header("Retry-After", f"{retry_after_s:.3f}")
+            if close:
+                # The keep-alive contract's one deliberate hangup: a
+                # DRAINING server (or a torn stream) ends the channel —
+                # send_header("Connection", "close") also flips
+                # close_connection so the handler loop exits.
+                self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(body)
 
@@ -151,7 +204,16 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                              "endpoints": _ENDPOINTS})
 
         def do_POST(self):  # noqa: N802 (stdlib name)
+            if self.path == "/predict_voxels_stream":
+                self._stream()
+                return
             if self.path not in ("/predict", "/predict_voxels"):
+                # Drain the body before answering: an unread body on a
+                # keep-alive channel would be parsed as the NEXT
+                # request's request line (channel desync).
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0)
+                )
                 self._json(404, {"error": "not_found",
                                  "endpoints": _ENDPOINTS})
                 return
@@ -191,12 +253,16 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                 # A handler thread that slipped in between shutdown()
                 # and drain() gets the batcher's "draining" refusal —
                 # answer it structurally like any other rejection, not
-                # with a dropped socket. (OverloadError is a
-                # RuntimeError; its clause above must come first.)
+                # with a dropped socket, and CLOSE the channel: the
+                # server is going away, so a pooled client must retire
+                # it rather than park a retry on a corpse.
+                # (OverloadError is a RuntimeError; its clause above
+                # must come first.)
                 self._json(503, self._reject_body(
                     {"error": "draining", "detail": str(e)}
                 ), trace_id=trace_id,
-                    retry_after_s=service.batcher.retry_after_s)
+                    retry_after_s=service.batcher.retry_after_s,
+                    close=True)
                 return
             try:
                 row = fut.result(timeout=request_timeout_s)
@@ -211,6 +277,149 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                 return
             self._json(200, service.format_row(row),
                        trace_id=fut.trace_id)
+
+        # -- the streamed multi-part protocol ------------------------------
+        def _read_exact(self, n: int) -> bytes:
+            """Exactly ``n`` body bytes (a buffered socket read may come
+            up short mid-frame); fewer means the peer hung up early —
+            the torn-frame shape the caller turns into a 400."""
+            chunks = []
+            while n > 0:
+                chunk = self.rfile.read(n)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+                n -= len(chunk)
+            return b"".join(chunks)
+
+        def _chunk(self, data: bytes) -> None:
+            """One chunked-transfer chunk (the response side of the
+            stream: hex length, CRLF, payload, CRLF), flushed so the
+            client sees each frame's line the moment it resolves."""
+            self.wfile.write(f"{len(data):X}\r\n".encode("ascii")
+                             + data + b"\r\n")
+            self.wfile.flush()
+
+        def _stream(self) -> None:
+            """``POST /predict_voxels_stream``: length-prefixed float32
+            frames in, one JSON line per frame out (chunked), every
+            frame an independent lane-tagged batcher request with its
+            own ``<stream>.<i>`` trace id. Framing errors are a
+            structured 400 BEFORE any response line; per-frame failures
+            (overload, timeout, forward error) are error LINES."""
+            stream_id = normalize_trace_id(self.headers.get(TRACE_HEADER))
+            if len(stream_id) > _MAX_STREAM_ID_LEN:
+                stream_id = mint_trace_id()
+            lane = normalize_lane(self.headers.get(PRIORITY_HEADER))
+            remaining = int(self.headers.get("Content-Length") or 0)
+            want = service.cfg.resolution ** 3 * 4
+            frames: list = []  # (index, future | None, error dict | None)
+
+            def torn(detail: str) -> None:
+                # The byte stream is unreliable past a torn frame: the
+                # channel closes with the 400 (admitted frames still
+                # resolve server-side; their results are discarded).
+                self._json(400, {
+                    "error": "bad_stream", "detail": detail,
+                    "frames_admitted": sum(
+                        1 for _, fut, _ in frames if fut is not None
+                    ),
+                }, trace_id=stream_id, close=True)
+
+            while remaining > 0:
+                if remaining < 4:
+                    torn(f"torn length prefix at frame {len(frames)}: "
+                         f"{remaining} byte(s) left, need 4")
+                    return
+                prefix = self._read_exact(4)
+                if len(prefix) < 4:
+                    torn(f"body ended inside frame {len(frames)}'s "
+                         "length prefix")
+                    return
+                remaining -= 4
+                n = struct.unpack("<I", prefix)[0]
+                if n != want:
+                    torn(f"frame {len(frames)} declares {n} bytes; a "
+                         f"[{service.cfg.resolution}]^3 float32 grid "
+                         f"is {want}")
+                    return
+                if n > remaining:
+                    torn(f"frame {len(frames)} declares {n} bytes but "
+                         f"only {remaining} remain in the body")
+                    return
+                payload = self._read_exact(n)
+                remaining -= len(payload)
+                if len(payload) < n:
+                    torn(f"body ended inside frame {len(frames)}'s "
+                         f"payload ({len(payload)}/{n} bytes)")
+                    return
+                i = len(frames)
+                trace_id = f"{stream_id}.{i}"
+                try:
+                    fut = service.submit_voxels(
+                        _parse_voxels(payload, service.cfg.resolution),
+                        trace_id=trace_id, lane=lane,
+                    )
+                    frames.append((i, fut, None))
+                except OverloadError as e:
+                    # A shed frame is that FRAME's structured error
+                    # line, not a dead stream: the client learns which
+                    # parts to resubmit without losing the socket.
+                    frames.append((i, None, {
+                        "trace": e.trace_id or trace_id,
+                        **self._reject_body(e.response),
+                    }))
+                except RuntimeError as e:
+                    frames.append((i, None, {
+                        "trace": trace_id, "error": "draining",
+                        "detail": str(e),
+                    }))
+            if not frames:
+                self._json(400, {
+                    "error": "bad_stream",
+                    "detail": "empty stream (no frames in body)",
+                }, trace_id=stream_id)
+                return
+            # Every frame read and admitted (or per-frame refused):
+            # stream the response lines in frame order as each resolves.
+            # One STREAM-level deadline, not one per frame: a wedged
+            # service must bound the whole response at the request
+            # timeout (later frames then time out immediately), never
+            # frames × timeout with the client long gone.
+            deadline = time.monotonic() + request_timeout_s
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header(TRACE_HEADER, stream_id)
+            self.end_headers()
+            for i, fut, err in frames:
+                if err is not None:
+                    line: dict = {"frame": i, **err}
+                else:
+                    try:
+                        row = fut.result(timeout=max(
+                            0.0, deadline - time.monotonic()
+                        ))
+                        line = {"frame": i, "trace": fut.trace_id,
+                                **service.format_row(row)}
+                    except TimeoutError:
+                        line = {"frame": i, "trace": fut.trace_id,
+                                "error": "timeout",
+                                "timeout_s": request_timeout_s}
+                    except RuntimeError as e:
+                        line = {"frame": i, "trace": fut.trace_id,
+                                "error": "forward_failed",
+                                "detail": str(e)}
+                try:
+                    self._chunk(json.dumps(line).encode("utf-8") + b"\n")
+                except OSError:
+                    # The client hung up mid-stream: stop resolving
+                    # lines for a dead socket (admitted frames still
+                    # compute; their results are discarded).
+                    self.close_connection = True
+                    return
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
 
     srv = ThreadingHTTPServer((host, port), Handler)
     srv.daemon_threads = True

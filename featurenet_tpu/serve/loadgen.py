@@ -20,10 +20,17 @@ a client policy, not a generator's.
 service (throughput is weight-agnostic) measured at a target fraction of
 the closed-loop rate, returning the flat ``serve_*`` fields bench pins in
 ``gate_summary``.
+
+``stream_load`` is this module's wire-speaking client: the streamed
+multi-part protocol (``POST /predict_voxels_stream``) over ONE
+keep-alive socket — the persistent-connection discipline both load
+generators now follow (the fleet generator pools its channels;
+the stream client needs exactly one).
 """
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Optional, Sequence
 
@@ -152,6 +159,82 @@ def poisson_load(service, qps: float, n_requests: int,
                  client_p99_ms=stats["client_p99_ms"],
                  offered_qps=stats["offered_qps"])
     return stats, futures
+
+
+def stream_load(host: str, port: int, grids, lane: str = "interactive",
+                timeout_s: float = 120.0,
+                trace_id: Optional[str] = None) -> dict:
+    """The stream-protocol client: pipeline every grid in ``grids`` over
+    ONE keep-alive socket as length-prefixed float32 frames
+    (``POST /predict_voxels_stream``) and collect the per-frame JSON
+    response lines as the server streams them back. This is the client
+    half of the persistent data plane for batched work — hundreds of
+    parts, one handshake — so ``reconnects`` is 0 by construction and
+    reported anyway, keeping the bench-row schema aligned with the
+    per-request generators. Returns status, the stream id the server
+    echoed, per-frame lines (in frame order), and the answered/error
+    split."""
+    import http.client
+    import struct
+
+    from featurenet_tpu.obs.tracing import TRACE_HEADER
+    from featurenet_tpu.serve.http import PRIORITY_HEADER
+
+    frames = []
+    for g in grids:
+        payload = np.ascontiguousarray(
+            np.asarray(g).reshape(np.asarray(g).shape[:3]), dtype="<f4"
+        ).tobytes()
+        frames.append(struct.pack("<I", len(payload)) + payload)
+    body = b"".join(frames)
+    headers = {"Content-Type": "application/octet-stream",
+               PRIORITY_HEADER: lane}
+    if trace_id:
+        headers[TRACE_HEADER] = trace_id
+    # lint: allow-raw-conn(the stream protocol IS one persistent socket — a pool adds nothing to a single-channel client)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    t0 = time.perf_counter()
+    lines: list[dict] = []
+    try:
+        conn.request("POST", "/predict_voxels_stream", body=body,
+                     headers=headers)
+        resp = conn.getresponse()
+        stream_id = resp.getheader(TRACE_HEADER)
+        if resp.status != 200:
+            try:
+                err = json.loads(resp.read().decode("utf-8"))
+            except ValueError:
+                err = {}
+            return {"status": resp.status, "stream_id": stream_id,
+                    "frames": len(frames), "answered": 0,
+                    "errors": len(frames), "lines": [], "detail": err,
+                    "reconnects": 0}
+        # readline through the chunked decoder: each line lands the
+        # moment its frame resolves server-side.
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw.decode("utf-8")))
+    finally:
+        conn.close()
+    wall = time.perf_counter() - t0
+    ok = [ln for ln in lines
+          if "label" in ln or "voxel_counts" in ln]
+    return {
+        "status": 200,
+        "stream_id": stream_id,
+        "frames": len(frames),
+        "answered": len(ok),
+        "errors": len(lines) - len(ok),
+        "lines": lines,
+        "wall_s": round(wall, 4),
+        "frames_per_sec": round(len(lines) / wall, 1) if wall > 0
+        else None,
+        "reconnects": 0,
+    }
 
 
 def _build_service(cfg, buckets: Sequence[int], max_wait_ms: float,

@@ -39,6 +39,10 @@ _EVENT_COUNTERS = {
     "cache_reject": "exec_cache_rejects_total",
     "overload": "overloads_total",
     "serve_batch": "serve_batches_total",
+    # Persistent-connection data plane (fleet.pool): channel lifecycle.
+    "conn_open": "connections_opened_total",
+    "conn_reuse": "connections_reused_total",
+    "conn_retire": "connections_retired_total",
 }
 
 _QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
@@ -55,6 +59,12 @@ METRIC_NAMES = frozenset(
         "trace_admitted_total",
         "trace_sampled_total",
         "trace_forced_total",
+        # Router flavor (render_router_metrics): routing outcomes and
+        # the pool's own counters — labeled by outcome / reason. The
+        # pool counters deliberately mirror the event-counter names so
+        # a dashboard reads one series whichever process exported it.
+        "fleet_requests_total",
+        "connections_retired_total",
     }
     | set(_EVENT_COUNTERS.values())
     # One gauge family per rolling window (quantile-labeled) + its count.
@@ -71,6 +81,17 @@ def _fmt(v) -> str:
     return format(float(v), "g")
 
 
+def _row(lines: list[str], name: str, value, labels: str = "",
+         kind: str | None = None) -> None:
+    """One exposition row (with its ``# TYPE`` line when ``kind`` is
+    given) — the single row builder behind BOTH exporters, so a format
+    change can never diverge them."""
+    full = _PREFIX + name
+    if kind is not None:
+        lines.append(f"# TYPE {full} {kind}")
+    lines.append(f"{full}{labels} {_fmt(value)}")
+
+
 def render_metrics(service) -> str:
     """The /metrics body for one ``InferenceService``: counters first,
     then the rolling-window quantile gauges. Honest absence throughout —
@@ -80,10 +101,7 @@ def render_metrics(service) -> str:
 
     def row(name: str, value, labels: str = "",
             kind: str | None = None) -> None:
-        full = _PREFIX + name
-        if kind is not None:
-            lines.append(f"# TYPE {full} {kind}")
-        lines.append(f"{full}{labels} {_fmt(value)}")
+        _row(lines, name, value, labels, kind)
 
     health = service.health()
     row("ready", health["ready"], kind="gauge")
@@ -110,6 +128,13 @@ def render_metrics(service) -> str:
     row("trace_sampled_total", tc["sampled"], kind="counter")
     row("trace_forced_total", tc["forced"], kind="counter")
 
+    _window_lines(lines)
+    return "\n".join(lines) + "\n"
+
+
+def _window_lines(lines: list[str]) -> None:
+    """The rolling-window quantile gauges (shared by the service and
+    router exporters — one formula, bit-equal to the report's)."""
     for metric, summary in sorted(_windows.snapshot().items()):
         full = _PREFIX + metric
         lines.append(f"# TYPE {full} gauge")
@@ -117,4 +142,42 @@ def render_metrics(service) -> str:
             lines.append(f'{full}{{q="{q}"}} {_fmt(summary[stat])}')
         lines.append(f"{_PREFIX}{metric}_count {summary['n']}")
 
+
+def render_router_metrics(router) -> str:
+    """The /metrics body for one ``FleetRouter``: routing outcomes, the
+    connection pool's own lifecycle counters (plain pool counters, so
+    the export works with no event sink installed), and the rolling
+    windows the router feeds (``serving_ms`` end-to-end walls,
+    ``connect_ms`` per fresh channel). Same honest-absence discipline
+    as the service exporter."""
+    lines: list[str] = []
+
+    def row(name: str, value, labels: str = "",
+            kind: str | None = None) -> None:
+        _row(lines, name, value, labels, kind)
+
+    st = router.stats()
+    row("ready", router.fleet.ready_count() > 0, kind="gauge")
+    row("fleet_requests_total", st["routed"], '{outcome="routed"}',
+        kind="counter")
+    row("fleet_requests_total", st["answered"], '{outcome="answered"}')
+    row("fleet_requests_total", st["rejected"], '{outcome="rejected"}')
+    row("fleet_requests_total", st["shed"], '{outcome="shed"}')
+    row("fleet_requests_total", st["dropped"], '{outcome="dropped"}')
+
+    pool = st.get("pool") or {}
+    row("connections_opened_total", pool.get("opened", 0), kind="counter")
+    row("connections_reused_total", pool.get("reused", 0), kind="counter")
+    retired = pool.get("retired") or {}
+    lines.append(f"# TYPE {_PREFIX}connections_retired_total counter")
+    if retired:
+        for reason, n in sorted(retired.items()):
+            lines.append(
+                f'{_PREFIX}connections_retired_total'
+                f'{{reason="{reason}"}} {_fmt(n)}'
+            )
+    else:
+        lines.append(f"{_PREFIX}connections_retired_total 0")
+
+    _window_lines(lines)
     return "\n".join(lines) + "\n"

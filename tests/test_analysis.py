@@ -699,7 +699,7 @@ def test_rule_registry_populated_at_import():
 
     assert set(RULE_NAMES) == {
         "telemetry", "fault-sites", "host-sync", "hygiene", "config-cli",
-        "spans", "alerts",
+        "spans", "raw-conn", "alerts",
     }
     assert set(RULES) == set(RULE_NAMES)
 
@@ -767,3 +767,47 @@ def test_package_self_clean_via_cli(capsys):
 
     main(["lint"])  # returns (exit 0) — raises SystemExit(2) on findings
     assert "lint: ok" in capsys.readouterr().out
+
+
+# --- rule: raw-conn ----------------------------------------------------------
+
+def test_raw_conn_outside_pool_caught(tmp_path):
+    """Raw HTTPConnection construction outside fleet/pool.py is the
+    connect-per-request regression sneaking back in — flagged with the
+    pool as the named alternative."""
+    path = _write(tmp_path, "client.py", """\
+        import http.client
+        conn = http.client.HTTPConnection("replica", 8000)
+    """)
+    findings = run_lint(str(tmp_path), rules=["raw-conn"])
+    assert _checks(findings) == ["raw_connection"]
+    assert findings[0].path == path and findings[0].line == 2
+    assert "fleet/pool.py" in findings[0].msg
+    assert "allow-raw-conn" in findings[0].msg
+
+
+def test_raw_conn_pool_module_and_escape_exempt(tmp_path):
+    """The pool module itself may construct connections (it IS the
+    factory), and a deliberate one-shot carries the reasoned escape —
+    on the line or a pure comment line above."""
+    _write(tmp_path, "fleet/pool.py", """\
+        import http.client
+        conn = http.client.HTTPConnection("replica", 8000)
+    """)
+    _write(tmp_path, "stream.py", """\
+        import http.client
+        # lint: allow-raw-conn(single-socket stream client)
+        conn = http.client.HTTPConnection("replica", 8000)
+        c2 = http.client.HTTPSConnection("replica", 443)  # lint: allow-raw-conn(tls probe)
+    """)
+    assert run_lint(str(tmp_path), rules=["raw-conn"]) == []
+
+
+def test_raw_conn_bare_name_and_https_caught(tmp_path):
+    _write(tmp_path, "client.py", """\
+        from http.client import HTTPConnection, HTTPSConnection
+        a = HTTPConnection("h", 80)
+        b = HTTPSConnection("h", 443)
+    """)
+    findings = run_lint(str(tmp_path), rules=["raw-conn"])
+    assert _checks(findings) == ["raw_connection", "raw_connection"]

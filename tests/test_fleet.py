@@ -47,10 +47,14 @@ RES = 16
 def _fake_replica(respond):
     """A scripted replica HTTP server: ``respond(path, body, headers) ->
     (status, payload_dict, headers_dict)``. Returns (server, port,
-    hits) — ``hits`` collects one record per POST."""
+    hits) — ``hits`` collects one record per POST. Speaks HTTP/1.1
+    keep-alive like the real replicas, so pooled-channel reuse is
+    exercised by every router test."""
     hits: list = []
 
     class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):  # noqa: N802
             pass
 
@@ -429,6 +433,237 @@ def test_router_sheds_batch_lane_first(tmp_path):
     assert len(shed) == 1 and shed[0]["lane"] == "batch"
 
 
+# --- the connection pool (fleet.pool) ----------------------------------------
+
+def test_pool_reuses_keepalive_channels():
+    """Sequential pooled POSTs to one endpoint pay ONE handshake: the
+    channel is checked back in and reused, and the counters prove it."""
+    from featurenet_tpu.fleet.pool import ConnectionPool
+
+    srv, port, hits = _ok_replica(1)
+    pool = ConnectionPool()
+    try:
+        for _ in range(4):
+            status, raw, _ = pool.post(
+                "127.0.0.1", port, "/predict_voxels", b"g", {}, 10.0
+            )
+            assert status == 200
+        st = pool.stats()
+        assert st["opened"] == 1 and st["reused"] == 3, st
+        assert st["reuse_ratio"] == pytest.approx(0.75)
+        assert len(hits) == 4
+    finally:
+        pool.close()
+        srv.shutdown()
+    assert pool.stats()["retired"].get("shutdown") == 1
+
+
+def test_pool_max_idle_and_max_age_eviction():
+    """The bounded-idle and max-age retirement units: a check-in beyond
+    the idle bound retires the extra channel (idle_overflow); an idle
+    channel older than max_age_s is retired at the next checkout and a
+    fresh one opened (max_age)."""
+    from featurenet_tpu.fleet.pool import ConnectionPool
+
+    srv, port, _ = _ok_replica(1)
+    pool = ConnectionPool(max_idle_per_endpoint=1, max_age_s=0.2)
+    try:
+        a = pool.checkout("127.0.0.1", port)
+        b = pool.checkout("127.0.0.1", port)
+        pool.checkin(a)
+        pool.checkin(b)
+        st = pool.stats()
+        assert st["opened"] == 2
+        assert st["retired"].get("idle_overflow") == 1
+        assert st["idle"] == 1
+        time.sleep(0.25)  # the surviving idle channel outlives max_age_s
+        c = pool.checkout("127.0.0.1", port)
+        st = pool.stats()
+        assert st["retired"].get("max_age") == 1
+        assert st["opened"] == 3 and st["reused"] == 0
+        pool.retire(c, "shutdown")
+    finally:
+        pool.close()
+        srv.shutdown()
+
+
+def _closing_server():
+    """A scripted raw-socket server that answers one keep-alive-looking
+    response per CONNECTION and then hangs up — the stale-channel shape
+    (a peer may close an idle keep-alive connection at any time)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+
+    def run():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return  # listener closed: test over
+            with c:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = c.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+                if b"\r\n\r\n" not in data:
+                    continue
+                head, rest = data.split(b"\r\n\r\n", 1)
+                want = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        want = int(line.split(b":")[1])
+                while len(rest) < want:
+                    chunk = c.recv(65536)
+                    if not chunk:
+                        break
+                    rest += chunk
+                c.sendall(b"HTTP/1.1 200 OK\r\n"
+                          b"Content-Length: 2\r\n\r\nok")
+            # the with-block closed the socket: the client's pooled
+            # channel is now stale without knowing it
+
+    threading.Thread(target=run, daemon=True).start()
+    return srv, port
+
+
+def test_pool_stale_reuse_retries_fresh_never_raises():
+    """A keep-alive peer closing an idle channel between requests must
+    NOT surface as a connection failure (it would burn the router's one
+    re-submit on a healthy replica): the pool retires the stale channel
+    and retries once on a fresh connection, transparently."""
+    from featurenet_tpu.fleet.pool import ConnectionPool
+
+    srv, port = _closing_server()
+    pool = ConnectionPool()
+    try:
+        for i in range(3):
+            status, raw, _ = pool.post(
+                "127.0.0.1", port, "/x", b"body", {}, 10.0
+            )
+            assert status == 200 and raw == b"ok", (i, status, raw)
+        st = pool.stats()
+        # Every request after the first found a stale channel, retired
+        # it (broken), and succeeded on a fresh connection.
+        assert st["opened"] == 3, st
+        assert st["retired"].get("broken") == 2, st
+    finally:
+        pool.close()
+        srv.close()
+
+
+def test_pool_fresh_connection_failure_raises():
+    """A FRESH connection failing is the real replica-loss shape and
+    must raise — the router's re-submit-once semantics key off it."""
+    from featurenet_tpu.fleet.pool import ConnectionPool
+
+    pool = ConnectionPool()
+    with pytest.raises(OSError):
+        pool.post("127.0.0.1", _dead_port(), "/x", b"g", {}, 2.0)
+    pool.close()
+
+
+def test_pool_retire_endpoint_drops_idle_channels():
+    from featurenet_tpu.fleet.pool import ConnectionPool
+
+    srv, port, _ = _ok_replica(1)
+    pool = ConnectionPool()
+    try:
+        pool.post("127.0.0.1", port, "/predict_voxels", b"g", {}, 10.0)
+        assert pool.stats()["idle"] == 1
+        assert pool.retire_endpoint("127.0.0.1", port,
+                                    "probe_failure") == 1
+        st = pool.stats()
+        assert st["idle"] == 0
+        assert st["retired"].get("probe_failure") == 1
+        # The next request starts clean on a fresh connection.
+        status, _, _ = pool.post("127.0.0.1", port, "/predict_voxels",
+                                 b"g", {}, 10.0)
+        assert status == 200 and pool.stats()["opened"] == 2
+    finally:
+        pool.close()
+        srv.shutdown()
+
+
+def test_router_front_end_keepalive_and_metrics():
+    """The router front end speaks HTTP/1.1: one client socket serves
+    several routed requests, and GET /metrics exports the pool's
+    channel counters."""
+    import http.client
+
+    srv_a, port_a, hits_a = _ok_replica(7)
+    fleet = FakeFleet([Candidate(0, "127.0.0.1", port_a, 0)])
+    router = _router(fleet)
+    srv = router.make_server("127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10
+        )
+        sock = None
+        for _ in range(3):
+            conn.request("POST", "/predict_voxels", body=b"g")
+            resp = conn.getresponse()
+            assert resp.status == 200 and resp.version == 11
+            resp.read()
+            if sock is None:
+                sock = conn.sock
+        # Same client socket throughout: the front end never closed it.
+        assert conn.sock is sock
+        # Router-side: 3 forwards over a pooled channel = 1 handshake.
+        st = router.stats()["pool"]
+        assert st["opened"] == 1 and st["reused"] == 2, st
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 200
+        assert "featurenet_connections_opened_total 1" in text
+        assert "featurenet_connections_reused_total 2" in text
+        assert 'featurenet_fleet_requests_total{outcome="answered"} 3' \
+            in text
+        conn.close()
+    finally:
+        router.drain()
+        srv.shutdown()
+        srv_a.shutdown()
+
+
+def test_report_folds_connection_events(tmp_path):
+    """conn_open/conn_reuse/conn_retire land in the report: top-level
+    connections summary, mirrored under the fleet section, rendered."""
+    obs.init_run(str(tmp_path / "run"), process_index=0)
+    srv_a, port_a, _ = _ok_replica(3)
+    fleet = FakeFleet([Candidate(0, "127.0.0.1", port_a, 0)])
+    router = _router(fleet)
+    try:
+        for _ in range(4):
+            status, _, _ = router.route("/predict_voxels", b"g")
+            assert status == 200
+    finally:
+        router.drain()
+        obs.close_run()
+        srv_a.shutdown()
+    events, bad = load_events(str(tmp_path / "run"))
+    assert bad == 0
+    assert sum(e["ev"] == "conn_open" for e in events) == 1
+    assert sum(e["ev"] == "conn_reuse" for e in events) == 3
+    opens = [e for e in events if e["ev"] == "conn_open"]
+    assert opens[0]["endpoint"] == f"127.0.0.1:{port_a}"
+    assert opens[0]["connect_ms"] >= 0
+    retires = [e for e in events if e["ev"] == "conn_retire"]
+    assert retires and all(e["reason"] == "shutdown" for e in retires)
+    rep = build_report(events)
+    assert rep["connections"]["opened"] == 1
+    assert rep["connections"]["reused"] == 3
+    assert rep["connections"]["reuse_ratio"] == pytest.approx(0.75)
+    assert rep["connections"]["retired"].get("shutdown") == 1
+    text = format_report(rep)
+    assert "connections: 1 opened, 3 reused" in text
+
+
 def test_scale_verdict_units():
     # No routable replica → add, regardless of latency history.
     assert scale_verdict(None, 0.0, ready=0) == "add"
@@ -630,6 +865,13 @@ def test_fleet_e2e_replica_loss_zero_drops_cached_rejoin(
         st = router.drain()
         assert st["exit_code"] == 0, st
         assert st["dropped"] == 0
+        # The pooled data plane carried the whole run: channels were
+        # REUSED (not one handshake per forward), and the kill retired
+        # channels instead of leaking corpse sockets into later
+        # forwards — the zero-drop assertion above is the oracle that
+        # retirement preserved the re-submit-once semantics.
+        assert st["pool"]["reused"] > st["pool"]["opened"], st["pool"]
+        assert st["pool"]["reuse_ratio"] > 0.5, st["pool"]
     finally:
         if srv is not None:
             srv.shutdown()
@@ -656,6 +898,15 @@ def test_fleet_e2e_replica_loss_zero_drops_cached_rejoin(
             if e["ev"] == "cache_hit" and e["t"] > t_loss]
     # Scale verdicts were advisory events, not load-bearing.
     assert [e for e in events if e["ev"] == "fleet_scale"]
+    # The channel lifecycle is in the stream: opens with their
+    # connect_ms, reuses, and the kill's retirements (broken and/or
+    # replica_loss/probe_failure — the loss was discovered somewhere).
+    assert [e for e in events if e["ev"] == "conn_open"]
+    assert [e for e in events if e["ev"] == "conn_reuse"]
+    retire_reasons = {e["reason"] for e in events
+                      if e["ev"] == "conn_retire"}
+    assert retire_reasons & {"broken", "replica_loss", "probe_failure"}, \
+        retire_reasons
     # The roster file is the elastic schema, final state = full strength.
     m = read_membership(run_dir)
     assert m is not None and m.members == (0, 1)

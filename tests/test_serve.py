@@ -915,3 +915,330 @@ def test_fleet_shared_exec_cache_second_service_all_hits(tmp_path):
     hits = [e for e in events_b if e["ev"] == "cache_hit"]
     assert len(hits) >= len(buckets)
     assert not [e for e in events_b if e["ev"] == "cache_reject"]
+
+
+# --- persistent connections: keep-alive contract + the stream protocol -------
+
+def _fake_voxel_service(resolution: int = 4, mode: str = "ok"):
+    """A scripted service for HTTP-layer tests: submit_voxels resolves
+    immediately with the grid's sum as the 'row' (any de-mux or framing
+    mixup is a wrong label), 'draining' raises the batcher's refusal,
+    'overload' fast-rejects every submit."""
+    import types
+
+    from featurenet_tpu.serve.batcher import PendingRequest
+
+    class Svc:
+        class cfg:
+            pass
+
+        replica = None
+
+        def __init__(self):
+            self.cfg.resolution = resolution
+            self.batcher = types.SimpleNamespace(retry_after_s=0.1)
+            self.calls = 0
+
+        def submit_voxels(self, grid, trace_id=None, lane="interactive"):
+            self.calls += 1
+            if mode == "draining":
+                raise RuntimeError("batcher is draining")
+            if mode == "overload":
+                raise OverloadError(4, 4, trace_id=trace_id, lane=lane,
+                                    retry_after_s=0.05)
+            p = PendingRequest(
+                np.asarray(grid),
+                ctx=types.SimpleNamespace(trace_id=trace_id),
+            )
+            p.value = float(np.asarray(grid).sum())
+            p.t_done = time.perf_counter()
+            p._event.set()
+            return p
+
+        def format_row(self, row):
+            return {"label": int(row)}
+
+        def health(self):
+            return {"ready": True, "uptime_s": 1.0, "window_seq": 0}
+
+        def stats(self):
+            return {"served": self.calls, "rejected": 0, "errors": 0,
+                    "queue_depth": 0, "occupancy": None, "by_bucket": {}}
+
+    return Svc()
+
+
+def _voxel_body(resolution: int = 4, value: float = 1.0) -> bytes:
+    return np.full((resolution,) * 3, value, "<f4").tobytes()
+
+
+def test_http_keepalive_one_socket_serves_sequential_requests():
+    """The keep-alive contract: HTTP/1.1 + exact Content-Length means
+    ONE client socket serves N sequential /predict_voxels requests —
+    the server never closes mid-stream, and GETs ride the same channel."""
+    import http.client
+
+    from featurenet_tpu.serve.http import make_server
+
+    service = _fake_voxel_service()
+    srv = make_server(service, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10
+        )
+        sock = None
+        for i in range(6):
+            conn.request("POST", "/predict_voxels",
+                         body=_voxel_body(value=float(i)))
+            resp = conn.getresponse()
+            assert resp.status == 200 and resp.version == 11
+            body = json.loads(resp.read().decode())
+            assert body["label"] == i * 4 ** 3
+            assert resp.getheader("Connection") != "close"
+            if sock is None:
+                sock = conn.sock
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        # Same socket end to end: zero reconnects for the whole burst.
+        assert conn.sock is sock
+        assert service.calls == 6
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_http_draining_503_closes_channel_overload_keeps_it():
+    """The two 503 flavors part ways on the keep-alive contract: a
+    DRAINING refusal closes the channel (the server is going away), an
+    overload rejection keeps it open (the polite retry should ride the
+    warm channel)."""
+    import http.client
+
+    from featurenet_tpu.serve.http import make_server
+
+    draining = _fake_voxel_service(mode="draining")
+    srv = make_server(draining, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10
+        )
+        conn.request("POST", "/predict_voxels", body=_voxel_body())
+        resp = conn.getresponse()
+        assert resp.status == 503
+        assert json.loads(resp.read().decode())["error"] == "draining"
+        assert resp.getheader("Connection") == "close"
+        assert resp.will_close
+        conn.close()
+    finally:
+        srv.shutdown()
+
+    overloaded = _fake_voxel_service(mode="overload")
+    srv = make_server(overloaded, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10
+        )
+        conn.request("POST", "/predict_voxels", body=_voxel_body())
+        resp = conn.getresponse()
+        assert resp.status == 503
+        assert json.loads(resp.read().decode())["error"] == "overload"
+        assert resp.getheader("Connection") != "close"
+        sock = conn.sock
+        conn.request("POST", "/predict_voxels", body=_voxel_body())
+        resp = conn.getresponse()
+        assert resp.status == 503 and conn.sock is sock
+        resp.read()
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_stream_protocol_frames_labels_and_trace_ids():
+    """The stream wire format end to end against a scripted service:
+    every length-prefixed frame answers one JSON line with its own
+    ``<stream>.<i>`` trace id and the right label, in frame order."""
+    from featurenet_tpu.serve.http import make_server
+    from featurenet_tpu.serve.loadgen import stream_load
+
+    service = _fake_voxel_service()
+    srv = make_server(service, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        grids = [np.full((4, 4, 4), float(i), np.float32)
+                 for i in range(5)]
+        out = stream_load("127.0.0.1", srv.server_address[1], grids,
+                          trace_id="stream-test-1")
+        assert out["status"] == 200
+        assert out["stream_id"] == "stream-test-1"
+        assert out["answered"] == 5 and out["errors"] == 0
+        assert out["reconnects"] == 0
+        for i, line in enumerate(out["lines"]):
+            assert line["frame"] == i
+            assert line["trace"] == f"stream-test-1.{i}"
+            assert line["label"] == i * 4 ** 3
+    finally:
+        srv.shutdown()
+
+
+def test_stream_torn_frame_structured_400():
+    """Framing errors are a structured 400, not a dropped socket or a
+    numpy traceback: torn length prefix, short payload, wrong declared
+    size, and the empty stream each name their failure."""
+    import http.client
+    import struct
+
+    from featurenet_tpu.serve.http import make_server
+
+    service = _fake_voxel_service()
+    srv = make_server(service, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def post_stream(body: bytes):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10
+        )
+        try:
+            conn.request("POST", "/predict_voxels_stream", body=body)
+            resp = conn.getresponse()
+            doc = json.loads(resp.read().decode())
+            return resp.status, doc, resp.getheader("Connection")
+        finally:
+            conn.close()
+
+    try:
+        frame = _voxel_body()
+        ok_frame = struct.pack("<I", len(frame)) + frame
+        # Torn prefix: 2 trailing bytes where a 4-byte length belongs.
+        status, doc, conn_hdr = post_stream(ok_frame + b"\x01\x02")
+        assert status == 400 and doc["error"] == "bad_stream"
+        assert "torn length prefix" in doc["detail"]
+        assert doc["frames_admitted"] == 1
+        assert conn_hdr == "close"  # the byte stream is unreliable now
+        # Short payload: the prefix promises more bytes than the body.
+        status, doc, _ = post_stream(struct.pack("<I", len(frame))
+                                     + frame[:10])
+        assert status == 400 and "remain in the body" in doc["detail"]
+        # Wrong declared size: not a [R]^3 float32 grid.
+        status, doc, _ = post_stream(struct.pack("<I", 12) + b"x" * 12)
+        assert status == 400 and "float32 grid" in doc["detail"]
+        # Empty stream.
+        status, doc, _ = post_stream(b"")
+        assert status == 400 and "empty stream" in doc["detail"]
+    finally:
+        srv.shutdown()
+
+
+def test_stream_per_frame_overload_is_an_error_line():
+    """A shed frame is that frame's structured error LINE (with its
+    trace id), never a dead stream: the client learns which parts to
+    resubmit without losing the socket."""
+    from featurenet_tpu.serve.http import make_server
+    from featurenet_tpu.serve.loadgen import stream_load
+
+    service = _fake_voxel_service(mode="overload")
+    srv = make_server(service, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        grids = [np.full((4, 4, 4), 1.0, np.float32)] * 3
+        out = stream_load("127.0.0.1", srv.server_address[1], grids)
+        assert out["status"] == 200
+        assert out["answered"] == 0 and out["errors"] == 3
+        for i, line in enumerate(out["lines"]):
+            assert line["frame"] == i
+            assert line["error"] == "overload"
+            assert line["retry_after_s"] == 0.05
+    finally:
+        srv.shutdown()
+
+
+def test_stream_e2e_100_frames_one_socket_zero_compiles(
+    tmp_path, rng, predictor
+):
+    """ISSUE 15 acceptance: ≥100 voxel frames pipelined over ONE client
+    socket through a real warmed service — every frame answered with
+    the reference label and its own stream-tied trace id, zero
+    ``program_compile`` events after warmup, and every frame's
+    admit/dispatch/done timeline in the run stream under its trace."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.serve.http import make_server
+    from featurenet_tpu.serve.loadgen import stream_load
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    service = InferenceService(
+        predictor, buckets=(1, 4, 16), max_wait_ms=5, queue_limit=256,
+        rules=(),
+    )
+    events, _ = load_events(run_dir)
+    compiles_at_warmup = sum(
+        1 for e in events if e["ev"] == "program_compile"
+    )
+    srv = make_server(service, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        base = generate_batch(rng, 24, RES)["voxels"]
+        expected, _ = predictor.predict_voxels(base)
+        n_frames = 120
+        grids = [base[i % len(base)] for i in range(n_frames)]
+        out = stream_load("127.0.0.1", srv.server_address[1], grids,
+                          trace_id="corpus-1")
+        assert out["status"] == 200
+        assert out["frames"] == n_frames
+        assert out["answered"] == n_frames and out["errors"] == 0
+        assert out["reconnects"] == 0  # one socket by construction
+        traces = set()
+        for i, line in enumerate(out["lines"]):
+            assert line["frame"] == i
+            assert line["trace"] == f"corpus-1.{i}"
+            traces.add(line["trace"])
+            assert line["label"] == int(expected[i % len(base)]), i
+        assert len(traces) == n_frames  # every frame its OWN trace id
+    finally:
+        srv.shutdown()
+        st = service.drain()
+    obs.close_run()
+    assert st["served"] >= n_frames
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    compiles_total = sum(
+        1 for e in events if e["ev"] == "program_compile"
+    )
+    assert compiles_total == compiles_at_warmup  # ZERO post-warmup
+    # The per-frame timelines are in the stream, tied to the stream id.
+    done = {e["trace"] for e in events if e["ev"] == "request_done"}
+    assert {f"corpus-1.{i}" for i in range(n_frames)} <= done
+
+
+def test_http_404_with_body_keeps_channel_in_sync():
+    """A POST to an unknown path drains its body before the 404: an
+    unread body on a keep-alive channel would be parsed as the NEXT
+    request's request line (channel desync)."""
+    import http.client
+
+    from featurenet_tpu.serve.http import make_server
+
+    service = _fake_voxel_service()
+    srv = make_server(service, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10
+        )
+        conn.request("POST", "/predict_voxel_typo", body=b"x" * 512)
+        resp = conn.getresponse()
+        assert resp.status == 404
+        json.loads(resp.read().decode())
+        sock = conn.sock
+        # The channel survives, in sync: the next request parses clean.
+        conn.request("POST", "/predict_voxels", body=_voxel_body())
+        resp = conn.getresponse()
+        assert resp.status == 200 and conn.sock is sock
+        resp.read()
+        conn.close()
+    finally:
+        srv.shutdown()
