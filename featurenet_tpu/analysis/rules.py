@@ -39,6 +39,22 @@ def _call_owner(call: ast.Call) -> Optional[str]:
     return None
 
 
+def _owner_tail(call: ast.Call) -> Optional[str]:
+    """Trailing name of the owner expression: ``store`` for both
+    ``store.append(...)`` and ``self.store.append(...)``. ``_call_owner``
+    resolves only bare names, but long-lived handles (the time-series
+    store a scraper holds) usually live on ``self``."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    v = f.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return None
+
+
 def _str_arg(call: ast.Call, index: int = 0) -> Optional[str]:
     if len(call.args) > index:
         a = call.args[index]
@@ -72,12 +88,23 @@ def telemetry_rule(tree: Tree) -> list[Finding]:
     typo'd name is a window that never fills and an SLO/perf metric that
     silently watches nothing (the perf layer's ``mfu`` /
     ``achieved_bw_fraction`` feeds ride this check).
+
+    Time-series store writes are under the same closed registry: a
+    literal series name handed to a store handle's ``append(...)``
+    (``store`` / ``_store`` / ``tsdb`` / ``_tsdb`` owners, including
+    ``self.``-rooted ones) must be in ``serve.metrics.METRIC_NAMES`` —
+    the scraper filters scraped names against the registry at runtime,
+    so a typo'd literal append is a series the dashboard and burn-rate
+    readers would never look for.
     """
     from featurenet_tpu.obs.alerts import WINDOW_METRICS
     from featurenet_tpu.obs.report import (
         KNOWN_EVENT_KINDS,
         REQUIRED_EVENT_FIELDS,
     )
+    from featurenet_tpu.serve.metrics import METRIC_NAMES
+
+    _STORE_OWNERS = ("store", "_store", "tsdb", "_tsdb")
 
     findings: list[Finding] = []
     seen_kinds: set[str] = set()
@@ -102,6 +129,25 @@ def telemetry_rule(tree: Tree) -> list[Finding]:
                         f"observe of unknown window metric {metric!r} — "
                         "the aggregator would silently drop every sample; "
                         "add it to alerts.WINDOW_METRICS or fix the typo",
+                    ))
+                continue
+            if name == "append":
+                # Store-handle appends only: list.append and friends are
+                # everywhere, so the check keys on the owner's trailing
+                # name being a store handle AND the first arg being a
+                # string literal (a scraped variable name is filtered
+                # against the registry at runtime instead).
+                if _owner_tail(node) not in _STORE_OWNERS:
+                    continue
+                metric = _str_arg(node)
+                if metric is not None and metric not in METRIC_NAMES:
+                    findings.append(Finding(
+                        "telemetry", "unknown_tsdb_series", mod.path,
+                        node.lineno,
+                        f"tsdb append of series {metric!r} which is not "
+                        "in serve.metrics.METRIC_NAMES — the dashboard/"
+                        "burn-rate readers key on the closed registry; "
+                        "register the name or fix the typo",
                     ))
                 continue
             if name == "warn":
@@ -252,7 +298,12 @@ HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py",
                     # (every forward and probe checks a channel out), so
                     # it sits under the same discipline.
                     "fleet/replica.py", "fleet/router.py",
-                    "fleet/loadgen.py", "fleet/pool.py")
+                    "fleet/loadgen.py", "fleet/pool.py",
+                    # The scraper thread shares the manager's channel
+                    # pool with the router's forwards — a host sync (or
+                    # any device coupling) in its loop would stall the
+                    # data plane it is only supposed to observe.
+                    "fleet/scraper.py")
 
 
 def _is_host_sync(node: ast.Call) -> Optional[str]:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import time
 
 
 def _add_override_flags(p: argparse.ArgumentParser) -> None:
@@ -595,6 +596,39 @@ def main(argv=None) -> None:
     p_hist.add_argument("--json", action="store_true", dest="as_json",
                         help="one JSON object per round instead of the "
                              "table")
+    p_hist.add_argument("--gate", action="store_true", dest="trend_gate",
+                        help="judge the latest parseable round against "
+                             "the PREVIOUS one on the pinned bench keys "
+                             "(obs.gates tolerances + noisy-key slack) "
+                             "and exit 2 on a regression — a trend gate "
+                             "CI can run with no baseline file checked "
+                             "in")
+    p_dash = sub.add_parser("dash", allow_abbrev=False,
+                            help="live terminal fleet dashboard over a "
+                                 "run dir's time-series store "
+                                 "(featurenet_tpu.obs.dash): per-replica "
+                                 "qps/p99/queue sparklines, burn-rate "
+                                 "gauges, roster + scrape health — "
+                                 "renders from <run_dir>/tsdb alone, so "
+                                 "it works on a live fleet and on a "
+                                 "finished run identically")
+    p_dash.add_argument("run_dir", help="run directory (the fleet "
+                                        "scraper's store lives at "
+                                        "<run_dir>/tsdb)")
+    p_dash.add_argument("--once", action="store_true",
+                        help="render ONE frame and exit (tests/CI "
+                             "artifacts) instead of the live loop")
+    p_dash.add_argument("--interval", type=float, default=2.0,
+                        help="refresh interval in seconds (default 2)")
+    p_dash.add_argument("--window-s", type=float, default=300.0,
+                        dest="window_s",
+                        help="sparkline look-back window in seconds "
+                             "(default 300)")
+    p_dash.add_argument("--slos", default=None,
+                        help="burn-rate SLO spec for the gauges "
+                             "(obs.alerts.parse_slos, e.g. "
+                             "'serving_p99_ms<250@99%%'); default: the "
+                             "built-in serving objective")
     p_inf = sub.add_parser("infer", allow_abbrev=False,
                            help="classify or segment STL files with a "
                                 "trained checkpoint")
@@ -791,6 +825,12 @@ def main(argv=None) -> None:
                             "router's serving alert rules and the "
                             "advisory fleet_scale verdicts "
                             "(default 250)")
+    p_flt.add_argument("--slos", default=None,
+                       help="burn-rate SLO objectives, comma-separated "
+                            "'metric<threshold@objective%%[:severity]' "
+                            "fragments (e.g. 'serving_p99_ms<250@99%%'); "
+                            "default: one p99 objective derived from "
+                            "--slo-p99-ms")
     p_flt.add_argument("--precision", choices=["fp32", "bf16", "int8"],
                        default=None,
                        help="replica serving precision (see `serve`)")
@@ -865,15 +905,53 @@ def main(argv=None) -> None:
         # table must render where no backend exists.
         from featurenet_tpu.obs.bench_history import (
             format_history,
+            format_trend_gate,
             load_rounds,
+            trend_gate,
         )
 
         rows = load_rounds(args.bench_dir)
         if args.as_json:
             for row in rows:
-                print(json.dumps(row))
+                # Underscore keys are internal (the trend gate's full
+                # value set); the JSON schema stays the table's.
+                print(json.dumps({k: v for k, v in row.items()
+                                  if not k.startswith("_")}))
         else:
             print(format_history(rows, bench_dir=args.bench_dir))
+        if args.trend_gate:
+            result = trend_gate(rows)
+            if args.as_json:
+                print(json.dumps({"trend_gate": result}))
+            else:
+                print(format_trend_gate(result))
+            if not result["ok"]:
+                raise SystemExit(2)
+        return
+
+    if args.cmd == "dash":
+        # The fleet dashboard: stdlib-only reads over <run_dir>/tsdb —
+        # works identically against a live fleet (the scraper is still
+        # appending) and a finished run dir.
+        from featurenet_tpu.obs.dash import render_frame
+
+        def frame() -> str:
+            return render_frame(args.run_dir, window_s=args.window_s,
+                                slos=args.slos)
+
+        try:
+            if args.once:
+                print(frame(), end="")
+                return
+            while True:
+                # ANSI clear + home, then the frame: dumb enough to
+                # pipe, no curses dependency.
+                print("\x1b[2J\x1b[H" + frame(), end="", flush=True)
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            print()
+        except ValueError as e:
+            raise SystemExit(f"dash: {e}")
         return
 
     if args.cmd == "lint":
@@ -960,6 +1038,13 @@ def main(argv=None) -> None:
             return
         rep = build_report(events, load_manifest(args.run_dir),
                            bad_lines=bad)
+        # Fleet runs leave a <run_dir>/tsdb behind (the scraper's store);
+        # fold its per-replica timeline in — absent for non-fleet runs.
+        from featurenet_tpu.obs.report import fleet_timeline_section
+
+        timeline = fleet_timeline_section(args.run_dir)
+        if timeline is not None:
+            rep["fleet_timeline"] = timeline
         if args.as_json:
             print(json.dumps(rep, indent=1, default=str))
         else:
@@ -1624,6 +1709,12 @@ def main(argv=None) -> None:
         from featurenet_tpu.fleet.loadgen import replica_argv
         from featurenet_tpu.fleet.replica import ReplicaManager
         from featurenet_tpu.fleet.router import FleetRouter
+        from featurenet_tpu.fleet.scraper import (
+            ROUTER_TARGET,
+            MetricsScraper,
+        )
+        from featurenet_tpu.obs import alerts as _alerts
+        from featurenet_tpu.obs import tsdb as _tsdb
 
         if args.replicas < 1:
             raise SystemExit(
@@ -1661,12 +1752,34 @@ def main(argv=None) -> None:
 
         manager = ReplicaManager(args.replicas, spawn, args.run_dir,
                                  host="127.0.0.1")
+        # The telemetry plane rides the run_dir: the scraper's samples
+        # land in <run_dir>/tsdb, which is what the router's burn-rate
+        # fleet_scale verdicts, `cli dash`, and the report fleet
+        # timeline all read. Config-time SLO validation: a malformed
+        # --slos spec refuses here, not mid-serve.
+        slos = None
+        if getattr(args, "slos", None):
+            try:
+                slos = _alerts.parse_slos(args.slos)
+            except ValueError as e:
+                raise SystemExit(f"--slos: {e}")
+        store = _tsdb.TimeSeriesStore.open(args.run_dir)
         router = FleetRouter(
             manager, slo_p99_ms=args.slo_p99_ms,
             batch_shed_depth=args.batch_shed_depth,
+            store=store, slos=slos,
         )
         manager.start()
         srv = router.make_server(host=args.host, port=args.port)
+        scraper = MetricsScraper(
+            store, manager.pool,
+            lambda: {
+                **{str(s): p
+                   for s, p in manager.stats()["ports"].items()},
+                ROUTER_TARGET: srv.server_address[1],
+            },
+        )
+        scraper.start()
         obs.emit("fleet_start", replicas=args.replicas,
                  host=srv.server_address[0], port=srv.server_address[1])
         threading.Thread(target=srv.serve_forever, name="fleet-http",
@@ -1691,9 +1804,15 @@ def main(argv=None) -> None:
         finally:
             for sig, h in prev_handlers.items():
                 signal.signal(sig, h)
+        # One final synchronous scrape before the replicas go away so
+        # the store's tail covers the whole run, then stop the thread
+        # before drain tears the pool's channels down.
+        scraper.stop()
         srv.shutdown()
         st = router.drain()
         manager.stop()
+        st["scrape"] = scraper.stats()
+        store.close()
         obs.close_run()
         print(json.dumps({"fleet_stats": st}))
         if args.drain and st["exit_code"]:

@@ -245,6 +245,8 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
     from featurenet_tpu.data.synthetic import generate_batch
     from featurenet_tpu.fleet.replica import ReplicaManager
     from featurenet_tpu.fleet.router import FleetRouter
+    from featurenet_tpu.fleet.scraper import ROUTER_TARGET, MetricsScraper
+    from featurenet_tpu.obs import tsdb as _tsdb
 
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     tmp = tempfile.mkdtemp(prefix="fleet_bench_")
@@ -263,7 +265,9 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
         )
 
     manager = ReplicaManager(replicas, spawn, run_dir, env=env)
-    router = FleetRouter(manager, rules=())
+    store = _tsdb.TimeSeriesStore.open(run_dir)
+    router = FleetRouter(manager, rules=(), store=store)
+    scraper = None
     srv = None
     try:
         manager.start()
@@ -277,6 +281,20 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
         srv = router.make_server("127.0.0.1", 0)
         port = srv.server_address[1]
         threading.Thread(target=srv.serve_forever, daemon=True).start()
+        # The telemetry plane, exactly as cli fleet wires it: the
+        # scraper collects every replica + the router into the run_dir
+        # store over the manager's own pool, aggressively (the bench
+        # must measure collection UNDER load, not a quiet fleet).
+        scraper = MetricsScraper(
+            store, manager.pool,
+            lambda: {
+                **{str(s): p
+                   for s, p in manager.stats()["ports"].items()},
+                ROUTER_TARGET: port,
+            },
+            interval_s=0.25,
+        )
+        scraper.start()
         grids = generate_batch(np.random.default_rng(0), 16, 16)["voxels"]
         kill_at = max(1, int(n_requests * kill_after_fraction))
         done = threading.Event()
@@ -297,6 +315,32 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
         stats, _ = http_load("127.0.0.1", port, qps, n_requests, grids)
         done.set()
         kt.join(timeout=1.0)
+        # Collection-tax A/B on the SAME warm fleet: a short open-loop
+        # burst with the scraper paused, then one with it collecting at
+        # its aggressive bench cadence. The pinned pct is the qps the
+        # serving path loses to collection — "never load-bearing" as a
+        # measured property (clamped at 0: a faster-with-scraper draw
+        # is noise, not negative overhead).
+        burst_n = max(40, n_requests // 4)
+        scraper.pause(True)
+        off, _ = http_load("127.0.0.1", port, qps, burst_n, grids)
+        scraper.pause(False)
+        on, _ = http_load("127.0.0.1", port, qps, burst_n, grids)
+        qps_off = off["sustained_qps"] or 0.0
+        qps_on = on["sustained_qps"] or 0.0
+        scrape_overhead_pct = (
+            max(0.0, (qps_off - qps_on) / qps_off * 100.0)
+            if qps_off > 0 else 0.0
+        )
+        # Burn-verdict decision latency: one store-backed burn query +
+        # verdict per call, best of a few (the autoscaler's read path).
+        t_best = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            router.scale_state()
+            dt = (time.perf_counter() - t0) * 1e3
+            t_best = dt if t_best is None else min(t_best, dt)
+        scraper.stop()
         st = router.drain()
         return {
             "fleet_replicas": replicas,
@@ -319,8 +363,18 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
             "fleet_conns_opened": st["pool"]["opened"],
             "fleet_conns_retired": sum(st["pool"]["retired"].values()),
             "fleet_client_reconnects": stats["reconnects"],
+            # The telemetry control plane's own pins: collection tax on
+            # the serving path and the burn-verdict decision latency,
+            # plus (unpinned) how much the store actually collected.
+            "scrape_overhead_pct": round(scrape_overhead_pct, 2),
+            "fleet_burn_verdict_ms": round(t_best, 3),
+            "fleet_scrape_samples": scraper.samples,
+            "fleet_scrape_rounds": scraper.rounds,
         }
     finally:
+        if scraper is not None:
+            scraper.pause(True)
+            scraper.stop(final_round=False)
         if srv is not None:
             srv.shutdown()
         manager.stop()

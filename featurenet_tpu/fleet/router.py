@@ -33,10 +33,18 @@ HTTP/1.1 keep-alive, so the client side of the hop persists too.
 
 Scaling verdicts are advisory, never load-bearing: the router feeds its
 end-to-end walls into the rolling ``serving_ms`` window (the SAME alert
-machinery every service runs) and a background cycle turns the window
-p99 + roster queue depths into ``fleet_scale{verdict: add|shed|hold}``
-events — what an autoscaler would subscribe to; nothing in the routing
-path reads them back.
+machinery every service runs) and a background cycle turns sustained SLO
+burn rates + roster queue depths into ``fleet_scale{verdict:
+add|shed|hold}`` events — what an autoscaler would subscribe to; nothing
+in the routing path reads them back. With a time-series store attached
+(``store=`` — the fleet CLI wires the scraper-fed ``obs.tsdb`` store),
+burn is evaluated multi-window over the fleet's durable history
+(``alerts.BurnEvaluator``: fast window proves "now", slow window proves
+"sustained", both must burn); without one it falls back to the router's
+own live ``serving_ms`` ring buffer — same math, process-local axis. A
+point-in-time p99 cannot tell a blip from a capacity problem; a burning
+slow window can, which is what makes these verdicts safe for an
+autoscaler to act on.
 
 Stdlib + numpy-free by contract (``analysis.rules.HOT_PATH_MODULES``):
 the router process owns no device and must survive every replica.
@@ -68,28 +76,40 @@ _ENDPOINTS = ["POST /predict", "POST /predict_voxels", "GET /stats",
               "GET /healthz", "GET /metrics"]
 
 # Queue depth (mean over ready replicas) above which the scale verdict
-# says "add" even while the p99 still holds — pressure building is the
+# says "add" even while the burn still holds — pressure building is the
 # earlier signal.
 _SCALE_ADD_DEPTH = 8.0
 
+# Slow-window burn below which an idle multi-replica fleet is provably
+# oversized: essentially no budget spent over the whole look-back.
+_SCALE_SHED_BURN = 0.1
 
-def scale_verdict(p99_ms: Optional[float], queue_depth: float,
-                  ready: int,
-                  slo_p99_ms: float = DEFAULT_SLO_P99_MS) -> str:
-    """The advisory verdict from one observation cycle: ``add`` when the
-    SLO is breached (or no replica is routable, or queues are building),
-    ``shed`` when the fleet is provably oversized (well under SLO, idle
-    queues, more than one replica), else ``hold``. Pure — unit-testable
-    without a fleet."""
+
+def scale_verdict(burn_fast: Optional[float], burn_slow: Optional[float],
+                  queue_depth: float, ready: int,
+                  max_burn: float = 1.0) -> str:
+    """The advisory verdict from one observation cycle, judged on SLO
+    burn rates rather than a point-in-time p99: ``add`` when no replica
+    is routable, when BOTH burn windows exceed ``max_burn`` (the
+    error budget is being spent faster than allowed, and has been for
+    the whole fast window — a sustained capacity problem, not a blip),
+    or when queues are building; ``shed`` when the fleet is provably
+    oversized (more than one replica, idle queues, and a slow window
+    that has burned almost nothing — sustained headroom); else
+    ``hold``. A burn window with no samples is ``None`` — honest
+    absence: it can neither justify an ``add`` nor (for the slow
+    window's sustained-headroom proof) block a ``shed``. Pure —
+    unit-testable without a fleet or a store."""
     if ready == 0:
         return "add"
-    if p99_ms is not None and p99_ms > slo_p99_ms:
+    if (burn_fast is not None and burn_slow is not None
+            and burn_fast > max_burn and burn_slow > max_burn):
         return "add"
     if queue_depth > _SCALE_ADD_DEPTH:
         return "add"
     if ready > 1 and queue_depth <= 0.5 and (
-        p99_ms is None or p99_ms < 0.25 * slo_p99_ms
-    ):
+        burn_slow is None or burn_slow < _SCALE_SHED_BURN
+    ) and (burn_fast is None or burn_fast < _SCALE_SHED_BURN):
         return "shed"
     return "hold"
 
@@ -105,13 +125,36 @@ class FleetRouter:
                  batch_shed_depth: int = DEFAULT_BATCH_SHED_DEPTH,
                  retry_after_s: float = DEFAULT_RETRY_AFTER_S,
                  request_timeout_s: float = 60.0,
-                 scale_every_s: float = DEFAULT_SCALE_EVERY_S):
+                 scale_every_s: float = DEFAULT_SCALE_EVERY_S,
+                 store=None,
+                 slos: Optional[Sequence] = None,
+                 burn_fast_s: float = _alerts.DEFAULT_FAST_WINDOW_S,
+                 burn_slow_s: float = _alerts.DEFAULT_SLOW_WINDOW_S):
         self.fleet = fleet
         self.slo_p99_ms = float(slo_p99_ms)
         self.batch_shed_depth = int(batch_shed_depth)
         self.retry_after_s = float(retry_after_s)
         self.request_timeout_s = float(request_timeout_s)
         self.scale_every_s = float(scale_every_s)
+        # The burn-rate SLO the scale verdicts judge: an explicit rule
+        # list (``slos=``, e.g. from ``--slos``), else the default
+        # serving objective at THIS router's SLO threshold — p99 under
+        # slo_p99_ms for 99% of samples, standard window pair unless
+        # overridden.
+        if slos is not None:
+            self._slos = list(slos)
+        else:
+            self._slos = [_alerts.BurnRateRule(
+                "serving_p99_ms", "<", self.slo_p99_ms, 0.99, "critical",
+                fast_s=float(burn_fast_s), slow_s=float(burn_slow_s),
+            )]
+        # With a store the evaluator reads the scraper-fed durable
+        # history (and owns the burn alerts' fire/resolve hysteresis);
+        # without one the tick computes the same burn over the live
+        # serving_ms ring buffer.
+        self._burn = _alerts.BurnEvaluator(store, self._slos) \
+            if store is not None else None
+        self.store = store
         # Forwards ride the replica provider's pool when it has one
         # (ReplicaManager owns it so /healthz probes share channels with
         # forwards); a bare provider (tests) gets the router's own. Only
@@ -145,17 +188,45 @@ class FleetRouter:
         self._scale_thread.start()
 
     # -- scaling verdicts (advisory) ------------------------------------------
-    def _scale_tick(self) -> None:
+    def scale_state(self) -> dict:
+        """One observation cycle's inputs + verdict: both burn windows
+        (from the store when attached, else the live window), mean
+        roster queue depth, ready count. This is what ``_scale_tick``
+        emits on change and what the bench pins time — one call is one
+        full burn-query + verdict evaluation."""
         cands = self.fleet.candidates()
         depth = (sum(c.score for c in cands) / len(cands)) if cands \
             else 0.0
-        p99 = (_windows.snapshot().get("serving_ms") or {}).get("p99")
-        verdict = scale_verdict(p99, depth, len(cands), self.slo_p99_ms)
-        if verdict != self._last_verdict:
-            self._last_verdict = verdict
-            obs.emit("fleet_scale", verdict=verdict,
-                     p99_ms=round(p99, 3) if p99 is not None else None,
-                     queue_depth=round(depth, 2), replicas=len(cands))
+        rule = self._slos[0]
+        if self._burn is not None:
+            res = self._burn.evaluate().get(rule.metric) or {}
+            fast, slow = res.get("fast"), res.get("slow")
+        else:
+            # Store-less fallback: identical math over the router's own
+            # serving_ms ring buffer (perf_counter axis end to end).
+            samples = _windows.samples("serving_ms")
+            now = time.perf_counter()
+            fast = _alerts.burn_rate(samples, rule, rule.fast_s, now)
+            slow = _alerts.burn_rate(samples, rule, rule.slow_s, now)
+        verdict = scale_verdict(fast, slow, depth, len(cands),
+                                rule.max_burn)
+        return {
+            "verdict": verdict,
+            "burn_fast": round(fast, 4) if fast is not None else None,
+            "burn_slow": round(slow, 4) if slow is not None else None,
+            "queue_depth": round(depth, 2),
+            "replicas": len(cands),
+        }
+
+    def _scale_tick(self) -> None:
+        st = self.scale_state()
+        if st["verdict"] != self._last_verdict:
+            self._last_verdict = st["verdict"]
+            obs.emit("fleet_scale", verdict=st["verdict"],
+                     burn_fast=st["burn_fast"],
+                     burn_slow=st["burn_slow"],
+                     queue_depth=st["queue_depth"],
+                     replicas=st["replicas"])
 
     def _scale_loop(self) -> None:
         while not self._scale_stop.wait(self.scale_every_s):
@@ -333,11 +404,20 @@ class FleetRouter:
 
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
-                    ready = router.fleet.ready_count() > 0 \
-                        and not router._draining
+                    healthy = router.fleet.ready_count()
+                    ready = healthy > 0 and not router._draining
+                    st = router.fleet.stats()
                     body = json.dumps({
                         "ready": ready, "fleet": True,
-                        **router.fleet.stats(),
+                        # Roster summary for external probes: how many
+                        # replicas are serving out of how many exist,
+                        # and whether the front door is closing — no
+                        # /metrics parsing required to answer "is this
+                        # fleet degraded".
+                        "healthy": healthy,
+                        "total": st.get("replicas", healthy),
+                        "draining": router._draining,
+                        **st,
                     }).encode()
                     self._send(200 if ready else 503, body, {})
                     return

@@ -1,0 +1,241 @@
+"""Fleet metrics scraper: the collection loop of the telemetry control
+plane.
+
+Every serving process already *exports* — each replica's ``GET
+/metrics`` and the router's own exporter speak Prometheus text — but
+until now nothing scraped them, so no signal survived a process exit.
+``MetricsScraper`` is a manager-owned daemon thread that closes that
+gap: on a jittered interval it fetches every replica's ``/metrics``
+plus the router's own over the SAME persistent connection pool the
+data plane uses (one socket per endpoint — a scrape reuses the warm
+channel, it never opens a side connection), parses the exposition text,
+and appends each sample to the run_dir time-series store
+(``obs.tsdb``) labeled with the replica that emitted it.
+
+Contracts, in order of importance:
+
+- **Never load-bearing.** A scrape failure increments a counter and
+  becomes a sample in the ``scrape_failures_total`` series — failures
+  are themselves telemetry, they never raise into the serving path or
+  stop the loop. The store itself degrades dark on disk errors.
+- **Closed registry.** Only series whose base name is in the exporters'
+  ``serve.metrics.METRIC_NAMES`` registry are written (plus the
+  scraper's own ``SCRAPER_SERIES``, registered there too). The analysis
+  lint pins this: no unregistered series can appear in the store.
+- **Jittered cadence.** Each round sleeps ``interval_s`` ±
+  ``jitter_frac`` so N fleets on one box don't thundering-herd their
+  replicas at the same instant.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from featurenet_tpu.serve.metrics import METRIC_NAMES, _PREFIX
+
+# The scraper's own series (registered in serve.metrics.METRIC_NAMES):
+# per-target failure counter and per-round collection wall — the
+# overhead evidence the bench pin reads.
+SCRAPER_SERIES = ("scrape_failures_total", "scrape_duration_ms")
+
+DEFAULT_INTERVAL_S = 1.0
+DEFAULT_JITTER_FRAC = 0.2
+DEFAULT_TIMEOUT_S = 2.0
+
+ROUTER_TARGET = "router"
+
+
+def parse_exposition(text: str) -> list[tuple[str, dict, float]]:
+    """Parse Prometheus text exposition 0.0.4 into (name, labels,
+    value) triples. Comment/HELP/TYPE lines are skipped; malformed
+    lines are skipped too (a scraper must survive a half-written
+    response). Shared with the exposition-compliance test, which is the
+    strict consumer — here we only need the samples."""
+    out: list[tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # <name>{k="v",...} <value>  |  <name> <value>
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            body, _, tail = rest.partition("}")
+            labels = {}
+            ok = True
+            for pair in _split_label_pairs(body):
+                k, eq, v = pair.partition("=")
+                if not eq or len(v) < 2 or v[0] != '"' or v[-1] != '"':
+                    ok = False
+                    break
+                labels[k.strip()] = _unescape(v[1:-1])
+            if not ok:
+                continue
+            value_str = tail.strip()
+        else:
+            name, _, value_str = line.partition(" ")
+            labels = {}
+        name = name.strip()
+        parts = value_str.split()
+        if not name or not parts:
+            continue
+        try:
+            value = float(parts[0])  # parts[1], if any, is a timestamp
+        except ValueError:
+            continue
+        out.append((name, labels, value))
+    return out
+
+
+def _split_label_pairs(body: str) -> list[str]:
+    """Split ``k="v",k2="v2"`` on commas OUTSIDE quotes."""
+    pairs, cur, in_q, esc = [], [], False, False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+            continue
+        if ch == "\\" and in_q:
+            cur.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+        if ch == "," and not in_q:
+            pairs.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        pairs.append("".join(cur))
+    return [p for p in (p.strip() for p in pairs) if p]
+
+
+def _unescape(v: str) -> str:
+    return v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
+
+
+class MetricsScraper:
+    """The manager-owned collection thread.
+
+    ``targets()`` must return ``{target_label: port}`` — the manager's
+    live replica ports keyed by slot, plus the router's own exporter
+    under ``ROUTER_TARGET``. Recomputed every round, so replicas that
+    die or rejoin fall out of / into collection automatically.
+    """
+
+    def __init__(self, store, pool, targets: Callable[[], dict], *,
+                 host: str = "127.0.0.1",
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 jitter_frac: float = DEFAULT_JITTER_FRAC,
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 registry: frozenset = METRIC_NAMES):
+        self.store = store
+        self.pool = pool
+        self.targets = targets
+        self.host = host
+        self.interval_s = float(interval_s)
+        self.jitter_frac = float(jitter_frac)
+        self.timeout_s = float(timeout_s)
+        self.registry = registry
+        self.rounds = 0
+        self.samples = 0
+        self.skipped = 0          # unregistered series (lint's backstop)
+        self.failures: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._paused = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-scraper", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, final_round: bool = True) -> None:
+        """Stop the loop; by default take one last synchronous round so
+        the store's tail reflects the fleet's final state."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.timeout_s + self.interval_s * 2)
+            self._thread = None
+        if final_round:
+            self.scrape_once()
+
+    def pause(self, on: bool = True) -> None:
+        """Suspend collection without tearing down the thread — the
+        bench harness uses this to measure serving qps with and without
+        the scraper on the same warm fleet."""
+        self._paused = on
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._paused:
+                self.scrape_once()
+            lo = self.interval_s * (1.0 - self.jitter_frac)
+            hi = self.interval_s * (1.0 + self.jitter_frac)
+            self._stop.wait(random.uniform(lo, hi))
+
+    # -- one collection round ------------------------------------------------
+    def scrape_once(self) -> int:
+        """Scrape every current target once; returns samples appended.
+        Failures never escape: each becomes a bump of that target's
+        failure counter and a sample in ``scrape_failures_total``."""
+        try:
+            targets = dict(self.targets())
+        except Exception:
+            targets = {}
+        appended = 0
+        for target, port in sorted(targets.items()):
+            appended += self._scrape_target(str(target), port)
+        self.rounds += 1
+        return appended
+
+    def _scrape_target(self, target: str, port: int) -> int:
+        t0 = time.perf_counter()
+        now = time.time()
+        try:
+            status, body = self.pool.get(
+                self.host, int(port), "/metrics", timeout_s=self.timeout_s
+            )
+            if status != 200:
+                raise OSError(f"/metrics -> {status}")
+            text = body.decode("utf-8", "replace")
+        except Exception:
+            n = self.failures.get(target, 0) + 1
+            self.failures[target] = n
+            # The failure IS a series: a dashboard sees collection gaps
+            # as data, not as absence.
+            self.store.append("scrape_failures_total", n,
+                              {"replica": target}, t=now)
+            return 0
+        appended = 0
+        for name, labels, value in parse_exposition(text):
+            base = name[len(_PREFIX):] if name.startswith(_PREFIX) else name
+            if base not in self.registry:
+                self.skipped += 1
+                continue
+            labels = dict(labels)
+            labels["replica"] = target
+            if self.store.append(base, value, labels, t=now):
+                appended += 1
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        self.store.append("scrape_duration_ms", dur_ms,
+                          {"replica": target}, t=now)
+        self.samples += appended
+        return appended
+
+    def stats(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "samples": self.samples,
+            "skipped": self.skipped,
+            "failures": dict(self.failures),
+            "paused": self._paused,
+        }

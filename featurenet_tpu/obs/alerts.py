@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import time
 from typing import Optional
 
 from featurenet_tpu.obs import events as _events
@@ -195,3 +196,233 @@ def fire(rule: AlertRule, value: float, window: int,
     _events.emit("alert", rule=rule.metric, severity=rule.severity,
                  value=round(float(value), 6), threshold=rule.threshold,
                  window=window, state=state)
+
+
+# --- multi-window burn-rate SLOs ---------------------------------------------
+#
+# Threshold rules above answer "is the metric bad RIGHT NOW"; an
+# error-budget objective answers "is it bad often enough, for long
+# enough, to matter". A burn-rate rule declares an objective over a
+# scraped series — e.g. "p99 serving latency under 250 ms for 99% of
+# samples" — and is evaluated at TWO look-back windows against the
+# time-series store: the burn rate of a window is
+#
+#     (fraction of the window's samples violating the objective)
+#     -----------------------------------------------------------
+#                 error budget (1 - objective)
+#
+# so burn 1.0 means "consuming budget exactly as fast as allowed". The
+# standard multi-window rule fires only when BOTH windows burn above
+# ``max_burn``: the fast window proves the problem is happening *now*
+# (and resolves the alert quickly after recovery), the slow window
+# proves it is *sustained* (one latency spike never pages). This is the
+# signal the router's ``fleet_scale`` verdict reads — a point-in-time
+# p99 cannot distinguish a blip from a capacity problem; a burning slow
+# window can.
+
+DEFAULT_FAST_WINDOW_S = 300.0    # 5 m
+DEFAULT_SLOW_WINDOW_S = 3600.0   # 1 h
+
+# Percentile-stat suffix → the exporter's quantile label on the scraped
+# series (serve.metrics._QUANTILES; mean/max are not exported, so burn
+# objectives are percentile-only by construction).
+_STAT_TO_Q = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
+
+
+def burn_selector(metric: str) -> Optional[tuple[str, dict]]:
+    """Map a burn-rule metric to its (series, labels) selector in the
+    time-series store — ``serving_p99_ms`` → (``serving_ms``,
+    ``{"q": "0.99"}``). None when the metric has no scraped series (not
+    burn-evaluable)."""
+    if metric == "serving_p99_ms":
+        return "serving_ms", {"q": "0.99"}
+    base, _, stat = metric.rpartition("_")
+    if base in WINDOW_METRICS and stat in _STAT_TO_Q:
+        return base, {"q": _STAT_TO_Q[stat]}
+    return None
+
+
+def known_burn_metrics() -> set[str]:
+    out = {"serving_p99_ms"}
+    for m in WINDOW_METRICS:
+        out.update(f"{m}_{s}" for s in _STAT_TO_Q)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnRateRule:
+    """One error-budget objective: ``value op threshold`` should hold
+    for ``objective`` of samples (e.g. ``serving_p99_ms<250@99%``). Note
+    ``op`` states the GOOD direction — the opposite convention from
+    ``AlertRule``, because an objective declares what health looks
+    like."""
+    metric: str
+    op: str           # "<" (good when below) or ">" (good when above)
+    threshold: float
+    objective: float  # fraction in (0, 1), e.g. 0.99
+    severity: str = "critical"
+    fast_s: float = DEFAULT_FAST_WINDOW_S
+    slow_s: float = DEFAULT_SLOW_WINDOW_S
+    max_burn: float = 1.0
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+    def bad(self, value: float) -> bool:
+        ok = value < self.threshold if self.op == "<" else \
+            value > self.threshold
+        return not ok
+
+    @property
+    def name(self) -> str:
+        """The alert-event rule name: the metric with a ``_burn``
+        suffix, so a burn alert is distinguishable from the
+        point-in-time threshold alert over the same metric."""
+        return f"{self.metric}_burn"
+
+
+# Default serving objective: p99 under the default SLO for 99% of
+# scraped samples — 1% error budget, standard 5m/1h window pair.
+DEFAULT_BURN_RULES = (
+    BurnRateRule("serving_p99_ms", "<", 250.0, 0.99, "critical"),
+)
+
+_SLO_RE = re.compile(
+    r"^(?P<metric>[a-z0-9_]+)(?P<op>[<>])(?P<threshold>[0-9.eE+-]+)"
+    r"@(?P<objective>[0-9.]+)%(?::(?P<severity>[a-z]+))?$"
+)
+
+
+def parse_slos(spec: Optional[str],
+               fast_s: float = DEFAULT_FAST_WINDOW_S,
+               slow_s: float = DEFAULT_SLOW_WINDOW_S) -> list[BurnRateRule]:
+    """Parse a burn-rate SLO spec (comma-separated
+    ``metric(<|>)threshold@objective%[:severity]`` entries, e.g.
+    ``serving_p99_ms<250@99%:critical``); ``None``/empty = the default
+    set. Same config-time refusal convention as ``parse_rules``: a typo
+    is an error now, not a silently dead objective later."""
+    if not spec:
+        return [dataclasses.replace(r, fast_s=fast_s, slow_s=slow_s)
+                for r in DEFAULT_BURN_RULES]
+    rules: list[BurnRateRule] = []
+    seen: set[str] = set()
+    valid = known_burn_metrics()
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _SLO_RE.match(entry)
+        if m is None:
+            raise ValueError(
+                f"malformed burn-rate SLO {entry!r}: expected "
+                "metric(>|<)threshold@objective%[:severity]"
+            )
+        metric = m.group("metric")
+        if metric not in valid:
+            raise ValueError(
+                f"unknown burn-rate metric {metric!r} in {entry!r}; "
+                f"known: {', '.join(sorted(valid))}"
+            )
+        if metric in seen:
+            raise ValueError(f"duplicate SLO metric {metric!r} in {spec!r}")
+        seen.add(metric)
+        try:
+            threshold = float(m.group("threshold"))
+            objective = float(m.group("objective")) / 100.0
+        except ValueError:
+            raise ValueError(
+                f"SLO numbers in {entry!r} must be numeric"
+            ) from None
+        if not 0.0 < objective < 1.0:
+            raise ValueError(
+                f"SLO objective in {entry!r} must be in (0, 100)% "
+                "exclusive — a 100% objective has no error budget to burn"
+            )
+        severity = m.group("severity") or "critical"
+        if severity not in SEVERITIES:
+            raise ValueError(
+                f"unknown SLO severity {severity!r} in {entry!r}; "
+                f"one of {', '.join(SEVERITIES)}"
+            )
+        rules.append(BurnRateRule(metric, m.group("op"), threshold,
+                                  objective, severity,
+                                  fast_s=fast_s, slow_s=slow_s))
+    if not rules:
+        raise ValueError(f"empty burn-rate SLO spec {spec!r}")
+    return rules
+
+
+def burn_rate(samples, rule: BurnRateRule, window_s: float,
+              now: Optional[float] = None) -> Optional[float]:
+    """The burn rate of one look-back window over raw (t, value)
+    samples: bad-sample fraction over the error budget. None when the
+    window holds no samples (honest absence — an empty window neither
+    fires nor resolves on its own authority)."""
+    if now is None:
+        now = time.time()
+    cutoff = now - float(window_s)  # lint: allow-wall-clock(sample axis)
+    vals = [v for t, v in samples if t >= cutoff]
+    if not vals:
+        return None
+    bad = sum(1 for v in vals if rule.bad(v))
+    return (bad / len(vals)) / rule.budget
+
+
+class BurnEvaluator:
+    """Multi-window burn evaluation over a time-series store, with the
+    same fire/resolve hysteresis (and the same ``alert`` event schema)
+    as the threshold engine — a burn alert's ``rule`` is
+    ``<metric>_burn``, its ``value`` the binding (smaller) window's burn
+    rate, its ``threshold`` the ``max_burn`` limit.
+
+    One evaluator instance belongs to one consumer (the fleet router's
+    scale loop); ``evaluate()`` is cheap enough to run every verdict
+    tick — one store query per rule, both windows cut from the same
+    sample list."""
+
+    def __init__(self, store, rules: Optional[list] = None):
+        self.store = store
+        self.rules = list(DEFAULT_BURN_RULES) if rules is None else \
+            list(rules)
+        self._active: dict[str, bool] = {}
+        self._seq = 0
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """One evaluation pass: per rule, both windows' burn rates and
+        the fire-when-both verdict; emits hysteretic ``alert`` events on
+        transitions. Returns ``{metric: {fast, slow, firing, active}}``."""
+        if now is None:
+            now = time.time()
+        self._seq += 1
+        out = {}
+        for rule in self.rules:
+            sel = burn_selector(rule.metric)
+            if sel is None:
+                continue
+            samples = self.store.query(
+                sel[0], sel[1], since_s=rule.slow_s, now=now
+            )
+            fast = burn_rate(samples, rule, rule.fast_s, now)
+            slow = burn_rate(samples, rule, rule.slow_s, now)
+            firing = (fast is not None and slow is not None
+                      and fast > rule.max_burn and slow > rule.max_burn)
+            active = self._active.get(rule.metric, False)
+            if firing != active:
+                # The binding window: both must burn to fire, so the
+                # smaller rate is the one that crossed last.
+                value = min(v for v in (fast, slow) if v is not None) \
+                    if (fast is not None or slow is not None) else 0.0
+                fire(AlertRule(rule.name, ">", rule.max_burn,
+                               rule.severity),
+                     value, self._seq,
+                     state="fire" if firing else "resolve")
+                self._active[rule.metric] = firing
+            out[rule.metric] = {
+                "fast": fast, "slow": slow,
+                "firing": firing, "active": firing,
+            }
+        return out
+
+    def active_alerts(self) -> list[str]:
+        return sorted(m for m, on in self._active.items() if on)

@@ -20,6 +20,8 @@ import os
 import re
 from typing import Optional
 
+from featurenet_tpu.obs import gates as _gates
+
 _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 # (artifact key, column header, format) — the columns worth reading
@@ -41,6 +43,8 @@ _COLUMNS = (
     ("fleet_p99_ms", "fl_p99", "{:.1f}"),
     ("fleet_requests_dropped", "fl_drop", "{:.0f}"),
     ("fleet_conn_reuse_ratio", "fl_reuse", "{:.2f}"),
+    ("scrape_overhead_pct", "scrape_%", "{:.1f}"),
+    ("fleet_burn_verdict_ms", "burn_ms", "{:.1f}"),
 )
 
 
@@ -99,6 +103,10 @@ def load_rounds(bench_dir: str = ".") -> list[dict]:
             for key, _, _ in _COLUMNS:
                 if isinstance(parsed.get(key), (int, float)):
                     row[key] = parsed[key]
+            # The FULL pinned-key set, for the trend gate below — the
+            # table renders _COLUMNS, the gate judges every gate key
+            # the round measured. Underscore key: not a column.
+            row["_gate_values"] = _gates.bench_gate_values(parsed)
             gate = parsed.get("gate")
             if isinstance(gate, dict) and "ok" in gate:
                 row["gate_ok"] = bool(gate["ok"])
@@ -106,6 +114,67 @@ def load_rounds(bench_dir: str = ".") -> list[dict]:
                     row["gate_failed"] = list(gate["failed"])
         rows.append(row)
     return rows
+
+
+def trend_gate(rows: list[dict],
+               tolerance: float = _gates.DEFAULT_TOLERANCE) -> dict:
+    """The round-over-round regression gate: judge the LATEST parseable
+    round against the PREVIOUS one on the pinned bench keys, using the
+    previous round's values as an ad-hoc baseline (same tolerance +
+    noisy-key absolute slack as bench.py's self-pin). This is what lets
+    CI gate a bench trajectory with no ``BENCH_baseline.json`` checked
+    in — the history IS the baseline.
+
+    Only keys present in BOTH rounds are judged: a conditional
+    measurement block (the e2e cache, a device-count-gated scaling
+    probe) legitimately comes and goes; a key the previous round never
+    measured is not a regression, it is noted in ``dropped``/``gained``.
+    Returns ``{"ok", "failed", "gates", "baseline_round",
+    "candidate_round", ...}``; fewer than two parseable rounds is a
+    trivially-ok gate with a ``note`` (nothing to trend ≠ a failure)."""
+    ok_rows = [r for r in rows
+               if r.get("status") == "ok" and r.get("_gate_values")]
+    if len(ok_rows) < 2:
+        return {
+            "ok": True, "failed": [], "gates": [],
+            "note": "fewer than two parseable rounds — nothing to trend",
+        }
+    prev, latest = ok_rows[-2], ok_rows[-1]
+    prev_vals = dict(prev["_gate_values"])
+    latest_vals = dict(latest["_gate_values"])
+    shared = {k: v for k, v in prev_vals.items() if k in latest_vals}
+    baseline = _gates.apply_abs_slack(
+        _gates.make_baseline(shared, tolerance=tolerance)
+    )
+    result = _gates.evaluate_gates(latest_vals, baseline)
+    result["baseline_round"] = prev["round"]
+    result["candidate_round"] = latest["round"]
+    result["dropped"] = sorted(set(prev_vals) - set(latest_vals))
+    result["gained"] = sorted(set(latest_vals) - set(prev_vals))
+    return result
+
+
+def format_trend_gate(result: dict) -> str:
+    if result.get("note"):
+        return f"trend gate: ok ({result['note']})"
+    head = (
+        f"trend gate ({result['candidate_round']} vs "
+        f"{result['baseline_round']}): "
+        + ("PASS" if result["ok"] else "FAIL")
+    )
+    lines = [head]
+    for g in result["gates"]:
+        if g["status"] == "pass":
+            continue
+        lines.append(
+            f"  FAIL {g['metric']:<36} {g['value']:>12.4g} vs limit "
+            f"{g['limit']:g} (prev {g['baseline']:g})"
+        )
+    for key, label in (("dropped", "no longer measured"),
+                       ("gained", "newly measured")):
+        if result.get(key):
+            lines.append(f"  note: {label}: {', '.join(result[key])}")
+    return "\n".join(lines)
 
 
 def format_history(rows: list[dict],

@@ -127,6 +127,15 @@ DIRECTIONS = {
     # cost; the pin is what enforces "never load-bearing" as a measured
     # property rather than a docstring claim.
     "trace_overhead_pct": "max",
+    # Telemetry-collection tax (fleet.loadgen.bench_fleet): open-loop
+    # fleet qps with the scraper collecting vs paused, same warm fleet.
+    # Regresses UPWARD for the same reason as trace_overhead_pct —
+    # "collection is never load-bearing" must be a measured property.
+    "scrape_overhead_pct": "max",
+    # One burn-query + scale-verdict evaluation wall (the router's
+    # store-backed ``scale_state``): the control loop's decision latency
+    # — PR-17's autoscaler acts on this, so it must stay cheap.
+    "fleet_burn_verdict_ms": "max",
     # Scaling-efficiency gate (the MULTICHIP_r0*.json series made
     # self-policing): per-chip train throughput at each power-of-two
     # data-mesh shape (benchmark.measure_scaling) regresses DOWNWARD,
@@ -276,6 +285,8 @@ BENCH_GATE_KEYS = (
     "fleet_p99_ms",
     "fleet_requests_dropped",
     "fleet_conn_reuse_ratio",
+    "scrape_overhead_pct",
+    "fleet_burn_verdict_ms",
 )
 
 
@@ -300,6 +311,67 @@ def make_baseline(values: dict[str, float],
             for name, v in sorted(values.items())
         }
     }
+
+
+# Measurement-quality / near-zero-baseline pins that need ABSOLUTE
+# slack on top of the relative tolerance: a relative tolerance on a
+# near-zero baseline pins "never change", so honest run-to-run wiggle
+# would fail the gate. One table, shared by bench.py's self-pin and the
+# bench-history trend gate — the two judges must agree on what counts
+# as noise. (Rationale per key lives with the bench harness; the values
+# are in the pinned metric's own units.)
+SPREAD_TOLERANCE_ABS = 5.0
+
+NOISY_KEY_ABS_SLACK = {
+    "spread_pct": SPREAD_TOLERANCE_ABS,
+    "serving_spread_pct": SPREAD_TOLERANCE_ABS,
+    "serving_int8_spread_pct": SPREAD_TOLERANCE_ABS,
+    "ttfs_cold_s": 10.0,
+    "ttfs_warm_s": 5.0,
+    "mfu_train": 0.02,
+    "serve_mfu": 0.02,
+    "hbm_peak_train_bytes": 32.0 * 1024 * 1024,
+    "train_bf16_master_spread_pct": SPREAD_TOLERANCE_ABS,
+    "mfu_train_bf16_master": 0.02,
+    "hbm_peak_train_bytes_bf16_master": 32.0 * 1024 * 1024,
+    "train_fp16_scaled_spread_pct": SPREAD_TOLERANCE_ABS,
+    "mfu_train_fp16_scaled": 0.02,
+    "hbm_peak_train_bytes_fp16_scaled": 32.0 * 1024 * 1024,
+    "train_fused33_spread_pct": SPREAD_TOLERANCE_ABS,
+    "serving_bf16_spread_pct": SPREAD_TOLERANCE_ABS,
+    "serve_mfu_bf16": 0.02,
+    "window_data_wait_p50_ms": 1.0,
+    "window_data_wait_p99_ms": 5.0,
+    "window_queue_depth_p50": 1.0,
+    "serve_p50_ms": 5.0,
+    "serve_p99_ms": 15.0,
+    "serve_client_p99_ms": 15.0,
+    "serve_rejected": 16.0,
+    "trace_overhead_pct": 10.0,
+    "data_wait_spread": 0.1,
+    "fleet_p99_ms": 25.0,
+    "fleet_conn_reuse_ratio": 0.05,
+    # Telemetry-collection tax: near zero by design (the scraper rides
+    # the warm pool off the hot path) — same reasoning as
+    # trace_overhead_pct, same room.
+    "scrape_overhead_pct": 10.0,
+    # One store query + verdict over a bench-sized store is
+    # single-digit ms; relative tolerance there pins "never change".
+    # The gate is for the control loop's decision latency growing to
+    # something an autoscaler would feel.
+    "fleet_burn_verdict_ms": 25.0,
+}
+
+
+def apply_abs_slack(baseline: dict) -> dict:
+    """Stamp ``NOISY_KEY_ABS_SLACK`` onto a ``make_baseline`` result's
+    pins (in place; returns it for chaining) — only keys actually
+    pinned get slack."""
+    for noisy, slack in NOISY_KEY_ABS_SLACK.items():
+        pin = baseline.get("gates", {}).get(noisy)
+        if pin is not None:
+            pin["tolerance_abs"] = slack
+    return baseline
 
 
 def evaluate_gates(values: dict[str, float], baseline: dict) -> dict:

@@ -920,9 +920,77 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
     return rep
 
 
+def fleet_timeline_section(run_dir: str, window_s: float = 3600.0,
+                           now: Optional[float] = None) -> Optional[dict]:
+    """The fleet timeline, from the time-series store ALONE: per target
+    (each replica + the router), the scraped ``serving_ms{q=0.99}``
+    history over the trailing look-back window — sample count,
+    last/median/max, and a sparkline. This answers "what did p99 look
+    like for the last hour, per replica" after every serving process has
+    exited, which the event stream cannot (windows die with their
+    process; the store is what the scraper built to outlive them). None
+    when the run_dir has no store (no fleet ran, or no scraper was
+    wired)."""
+    # Local imports: tsdb/dash import this module's _pct at module
+    # level — by call time report is fully loaded, so no cycle.
+    from featurenet_tpu.obs import tsdb as _tsdb
+    from featurenet_tpu.obs.dash import SPARK_SLOTS, _bucket, _spark
+
+    if not os.path.isdir(_tsdb.store_dir(run_dir)):
+        return None
+    store = _tsdb.TimeSeriesStore.open(run_dir)
+    targets: dict[str, dict] = {}
+    series = store.series()
+    if not series:
+        return None
+    if now is None:
+        # A finished run's "now" is the store's last sample, not the
+        # wall clock — a report rendered days later must still show the
+        # hour the fleet actually served.
+        now = max(
+            (s[0] for m, lb in series
+             for s in [store.latest(m, lb)] if s is not None),
+            default=time.time(),
+        )
+    names = sorted({lb.get("replica") for _m, lb in series
+                    if lb.get("replica") is not None})
+    for target in names:
+        samples = store.query("serving_ms",
+                              {"q": "0.99", "replica": target},
+                              since_s=window_s, now=now)
+        if not samples:
+            continue
+        vals = sorted(v for _t, v in samples)
+        targets[target] = {
+            "samples": len(samples),
+            "p99_ms_last": round(samples[-1][1], 3),
+            "p99_ms_median": round(_pct(vals, 50), 3),
+            "p99_ms_max": round(vals[-1], 3),
+            "spark": _spark(_bucket(samples, now, window_s, SPARK_SLOTS)),
+        }
+    if not targets:
+        return None
+    fails = 0
+    for metric, labels in series:
+        if metric == "scrape_failures_total":
+            last = store.latest(metric, labels)
+            if last is not None:
+                fails += int(last[1])
+    return {
+        "window_s": float(window_s),
+        "t_end": round(now, 3),
+        "targets": targets,
+        "scrape_failures": fails,
+    }
+
+
 def build_report_dir(run_dir: str) -> dict:
     events, bad = load_events(run_dir)
-    return build_report(events, load_manifest(run_dir), bad_lines=bad)
+    rep = build_report(events, load_manifest(run_dir), bad_lines=bad)
+    timeline = fleet_timeline_section(run_dir)
+    if timeline is not None:
+        rep["fleet_timeline"] = timeline
+    return rep
 
 
 def _fmt_s(v: float) -> str:
@@ -1212,6 +1280,21 @@ def format_report(rep: dict) -> str:
             detail = {k: v for k, v in e.items()
                       if k not in ("t", "event")}
             lines.append(f"  t={e['t']:.3f} {e['event']} {detail or ''}")
+    ft = rep.get("fleet_timeline")
+    if ft:
+        lines.append(
+            f"fleet timeline (tsdb, last {ft['window_s']:g}s): "
+            f"{len(ft['targets'])} target(s), "
+            f"{ft['scrape_failures']} scrape failure(s)"
+        )
+        for target, row in sorted(ft["targets"].items()):
+            lines.append(
+                f"  {target:<8} p99 {row['spark']} "
+                f"last {row['p99_ms_last']} ms · "
+                f"median {row['p99_ms_median']} ms · "
+                f"max {row['p99_ms_max']} ms "
+                f"({row['samples']} sample(s))"
+            )
     cn = rep.get("connections")
     if cn:
         ratio = cn.get("reuse_ratio")

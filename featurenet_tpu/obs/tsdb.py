@@ -1,0 +1,371 @@
+"""Append-only, run_dir-resident time-series store: the fleet's durable
+signal plane.
+
+Everything the live layers keep — rolling windows, pool counters,
+batcher stats — dies with its process. The store is what survives: the
+fleet scraper (``fleet.scraper``) appends every sample it collects to
+``<run_dir>/tsdb/``, and the burn-rate evaluator, ``cli dash``, and the
+report's fleet-timeline section all answer "what did p99 look like over
+the last hour, per replica" from these files alone — after every serving
+process has exited.
+
+Layout: one JSONL segment sequence per metric×labels series, the series
+identity encoded in the filename (``serving_ms;q=0.99;replica=0``
+→ ``serving_ms;q=0.99;replica=0.000000.jsonl``). Each line is one
+``{"t": epoch_seconds, "v": value}`` sample written with the event
+sink's durability discipline: O_APPEND fd, ONE ``os.write`` per complete
+line — concurrent writers interleave whole lines, a crash tears at most
+the final line. Readers skip torn tails and unparsable lines instead of
+failing (the same contract as the report's event loader), and merge a
+series' segments in timestamp order.
+
+Ring pruning: segments rotate at ``segment_bytes``; on every rotation
+the store drops closed segments older than ``max_age_s`` and then
+oldest-first until the directory fits ``max_bytes`` — so an arbitrarily
+long-lived fleet holds a bounded, recent history, like a Prometheus TSDB
+head block without the index machinery.
+
+Never load-bearing: the first OSError (disk full, permissions, a
+deleted run_dir) puts the writer in the dark — every later ``append`` is
+a counter bump and nothing else. Collection must not be able to take
+down the serving path it observes.
+
+Timestamps are wall-clock epoch seconds *by design*: samples from three
+processes (router + N replicas) must land on one comparable axis, and
+the axis must still mean something when the store is read days later.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+# ONE percentile implementation across live windows, report, and store:
+# nearest-rank, shared with obs.report/obs.windows.
+from featurenet_tpu.obs.report import _pct
+
+STORE_DIRNAME = "tsdb"
+
+DEFAULT_SEGMENT_BYTES = 256 * 1024
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+DEFAULT_MAX_AGE_S = 24 * 3600.0
+
+_SEG_SUFFIX = ".jsonl"
+_SEG_WIDTH = 6
+
+# Filename-safe charset for metric names and label keys/values. Anything
+# else becomes "_" — labels here are Prometheus label values (replica
+# slots, quantiles, outcomes, version strings), which fit comfortably.
+_SAFE = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_.-="
+)
+
+
+def _sanitize(token: str) -> str:
+    return "".join(c if c in _SAFE else "_" for c in str(token))
+
+
+def series_key(metric: str, labels: Optional[dict] = None) -> str:
+    """The canonical series identity: metric then sorted ``k=v`` pairs,
+    ``;``-joined. This string IS the segment filename stem, so two
+    writers composing the same (metric, labels) append to the same
+    series no matter the dict order."""
+    parts = [_sanitize(metric)]
+    for k in sorted(labels or {}):
+        parts.append(f"{_sanitize(k)}={_sanitize(labels[k])}")
+    return ";".join(parts)
+
+
+def parse_series_key(key: str) -> tuple[str, dict]:
+    """Inverse of ``series_key`` (modulo sanitization): filename stem →
+    (metric, labels)."""
+    parts = key.split(";")
+    labels = {}
+    for p in parts[1:]:
+        k, _, v = p.partition("=")
+        labels[k] = v
+    return parts[0], labels
+
+
+def store_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, STORE_DIRNAME)
+
+
+class TimeSeriesStore:
+    """Writer + reader over one ``<run_dir>/tsdb`` directory.
+
+    The writer half (``append``) is what the scraper holds; the reader
+    half (``query``/``percentile``/``series``) re-scans the directory on
+    every call, so a store opened read-only on a *finished* run_dir —
+    or on one another process is still appending to — needs no writer
+    state at all.
+    """
+
+    def __init__(self, root: str, *,
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 max_age_s: float = DEFAULT_MAX_AGE_S):
+        self.root = os.path.abspath(root)
+        self.segment_bytes = int(segment_bytes)
+        self.max_bytes = int(max_bytes)
+        self.max_age_s = float(max_age_s)
+        self._lock = threading.Lock()
+        # Per-series writer state: key -> [fd, seg_index, bytes_in_seg].
+        self._writers: dict[str, list] = {}
+        self._dark = False
+        self.appended = 0
+        self.dropped = 0
+
+    @classmethod
+    def open(cls, run_dir: str, **kw) -> "TimeSeriesStore":
+        """The store of one run directory (``<run_dir>/tsdb``)."""
+        return cls(store_dir(run_dir), **kw)
+
+    # -- write path ----------------------------------------------------------
+    def append(self, metric: str, value, labels: Optional[dict] = None,
+               t: Optional[float] = None) -> bool:
+        """Append one sample; True when it durably landed. Every failure
+        path is absorbed: a dark store drops samples and counts them —
+        telemetry is never load-bearing."""
+        if self._dark:
+            self.dropped += 1
+            return False
+        if t is None:
+            t = time.time()
+        line = json.dumps(
+            {"t": round(float(t), 3), "v": float(value)},
+            separators=(",", ":"),
+        ).encode("utf-8") + b"\n"
+        key = series_key(metric, labels)
+        try:
+            with self._lock:
+                st = self._writers.get(key)
+                if st is None:
+                    st = self._open_writer_locked(key)
+                    self._writers[key] = st
+                elif st[2] + len(line) > self.segment_bytes and st[2] > 0:
+                    self._rotate_locked(key, st)
+                # One write, one complete line: concurrent appenders
+                # interleave whole samples (O_APPEND), a crash tears at
+                # most the tail the readers already skip.
+                os.write(st[0], line)
+                st[2] += len(line)
+                self.appended += 1
+            return True
+        except OSError:
+            # Disk full / unlinked root / fd limit: go dark for good.
+            # A degraded store must never raise into the scrape loop.
+            self._go_dark()
+            self.dropped += 1
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            for st in self._writers.values():
+                try:
+                    os.close(st[0])
+                except OSError:
+                    pass
+            self._writers.clear()
+
+    def _go_dark(self) -> None:
+        self._dark = True
+        self.close()
+
+    def _open_writer_locked(self, key: str) -> list:
+        os.makedirs(self.root, exist_ok=True)
+        # Resume the highest existing segment so a reopened store (a
+        # respawned scraper) keeps one ordered sequence per series.
+        seg = 0
+        for _, idx, _p in self._segments_of(key):
+            seg = max(seg, idx)
+        path = self._seg_path(key, seg)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        size = os.fstat(fd).st_size
+        if size >= self.segment_bytes:
+            os.close(fd)
+            seg += 1
+            path = self._seg_path(key, seg)
+            fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+            size = os.fstat(fd).st_size
+        # A resumed segment ending mid-line is a predecessor's torn
+        # tail. Terminate it before appending: otherwise the first new
+        # sample would fuse with the tear into one unparsable line and
+        # both would be lost to the reader's skip.
+        if size > 0 and os.pread(fd, 1, size - 1) != b"\n":
+            size += os.write(fd, b"\n")
+        return [fd, seg, size]
+
+    def _rotate_locked(self, key: str, st: list) -> None:
+        try:
+            os.close(st[0])
+        except OSError:
+            pass
+        st[1] += 1
+        path = self._seg_path(key, st[1])
+        st[0] = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        st[2] = 0
+        self._prune_locked()
+
+    def _prune_locked(self) -> None:
+        """Drop closed segments by age, then oldest-first to the byte
+        budget. Active (currently-open) segments are never deleted."""
+        active = {
+            self._seg_path(k, st[1]) for k, st in self._writers.items()
+        }
+        segs = []  # (mtime, size, path)
+        for key in self._series_keys():
+            for path, _idx, stat in self._segments_of(key):
+                if path in active:
+                    continue
+                segs.append((stat.st_mtime, stat.st_size, path))
+        segs.sort()
+        now = time.time()
+        total = sum(s[1] for s in segs)
+        for mtime, size, path in segs:
+            age = now - mtime  # lint: allow-wall-clock(mtime is epoch-based)
+            too_old = age > self.max_age_s
+            if not too_old and total <= self.max_bytes:
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            total -= size
+
+    # -- directory scan ------------------------------------------------------
+    def _seg_path(self, key: str, seg: int) -> str:
+        return os.path.join(
+            self.root, f"{key}.{seg:0{_SEG_WIDTH}d}{_SEG_SUFFIX}"
+        )
+
+    def _series_keys(self) -> list[str]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        keys = set()
+        for n in names:
+            if not n.endswith(_SEG_SUFFIX):
+                continue
+            stem = n[: -len(_SEG_SUFFIX)]
+            stem, _, seg = stem.rpartition(".")
+            if stem and seg.isdigit():
+                keys.add(stem)
+        return sorted(keys)
+
+    def _segments_of(self, key: str):
+        """(path, index, stat) per existing segment of a series, index
+        order."""
+        out = []
+        prefix = key + "."
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for n in names:
+            if not (n.startswith(prefix) and n.endswith(_SEG_SUFFIX)):
+                continue
+            seg = n[len(prefix): -len(_SEG_SUFFIX)]
+            if not seg.isdigit():
+                continue
+            path = os.path.join(self.root, n)
+            try:
+                out.append((path, int(seg), os.stat(path)))
+            except OSError:
+                continue
+        out.sort(key=lambda s: s[1])
+        return out
+
+    # -- read path -----------------------------------------------------------
+    def series(self) -> list[tuple[str, dict]]:
+        """Every (metric, labels) series present on disk."""
+        return [parse_series_key(k) for k in self._series_keys()]
+
+    def _matching_keys(self, metric: str,
+                       labels: Optional[dict]) -> list[str]:
+        """Series whose metric matches and whose labels are a SUPERSET
+        of the filter — ``labels={"q": "0.99"}`` merges that quantile
+        across every replica."""
+        want = {str(k): str(v) for k, v in (labels or {}).items()}
+        out = []
+        for key in self._series_keys():
+            m, lb = parse_series_key(key)
+            if m != metric:
+                continue
+            if all(lb.get(k) == v for k, v in want.items()):
+                out.append(key)
+        return out
+
+    def query(self, metric: str, labels: Optional[dict] = None,
+              since_s: Optional[float] = None,
+              now: Optional[float] = None) -> list[tuple[float, float]]:
+        """Merged (t, value) samples of every matching series, timestamp
+        order, restricted to the trailing ``since_s`` look-back window.
+        Torn tails and unparsable lines are skipped, never raised."""
+        if now is None:
+            now = time.time()
+        cutoff = None if since_s is None else \
+            now - float(since_s)  # lint: allow-wall-clock(epoch axis)
+        out: list[tuple[float, float]] = []
+        for key in self._matching_keys(metric, labels):
+            for path, _idx, _stat in self._segments_of(key):
+                out.extend(self._read_segment(path, cutoff))
+        out.sort(key=lambda s: s[0])
+        return out
+
+    @staticmethod
+    def _read_segment(path: str, cutoff: Optional[float]):
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            return []
+        lines = raw.split(b"\n")
+        # A file not ending in newline ends in a torn write: the final
+        # chunk is incomplete by the one-write-per-line contract — drop
+        # it. (split leaves b"" as the last element when it DID end in
+        # a newline.)
+        lines = lines[:-1]
+        out = []
+        for ln in lines:
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+                t, v = float(rec["t"]), float(rec["v"])
+            except (ValueError, KeyError, TypeError):
+                continue  # torn or foreign line: skip, never fail a read
+            if cutoff is not None and t < cutoff:
+                continue
+            out.append((t, v))
+        return out
+
+    def percentile(self, metric: str, q: float,
+                   labels: Optional[dict] = None,
+                   since_s: Optional[float] = None,
+                   now: Optional[float] = None) -> Optional[float]:
+        """Nearest-rank percentile of the merged samples over the
+        look-back window (None when the window is empty) — the same
+        ``_pct`` the live windows and the report use."""
+        vals = sorted(v for _, v in self.query(
+            metric, labels, since_s=since_s, now=now
+        ))
+        return _pct(vals, q)
+
+    def latest(self, metric: str, labels: Optional[dict] = None
+               ) -> Optional[tuple[float, float]]:
+        """The newest (t, value) across matching series, or None."""
+        samples = self.query(metric, labels)
+        return samples[-1] if samples else None
+
+    def stats(self) -> dict:
+        return {
+            "appended": self.appended,
+            "dropped": self.dropped,
+            "dark": self._dark,
+            "series": len(self._series_keys()),
+        }

@@ -194,6 +194,19 @@ class WindowAggregator:
                     out[metric] = s
             return out
 
+    def samples(self, metric: str) -> list[tuple[float, float]]:
+        """Raw (timestamp, value) samples of one window, age-pruned.
+        Timestamps are ``perf_counter`` readings (the aggregator's
+        clock), so callers comparing against "now" must use
+        ``perf_counter`` too — this is the router's store-less burn
+        fallback, not the durable epoch axis the tsdb keeps."""
+        with self._lock:
+            win = self._win.get(metric)
+            if win is None:
+                return []
+            win.values(time.perf_counter())  # prune by age in place
+            return list(win._samples)
+
     @property
     def seq(self) -> int:
         """The latest emission sequence number (0 = none yet)."""
@@ -312,6 +325,16 @@ def snapshot() -> dict:
     if agg is None:
         return {}
     return agg.snapshot()
+
+
+def samples(metric: str) -> list[tuple[float, float]]:
+    """Raw (perf_counter, value) samples of one window of the installed
+    aggregator (empty when none) — the burn-rate fallback for a router
+    running without a time-series store."""
+    agg = _agg
+    if agg is None:
+        return []
+    return agg.samples(metric)
 
 
 def last_seq() -> Optional[int]:

@@ -59,18 +59,84 @@ METRIC_NAMES = frozenset(
         "trace_admitted_total",
         "trace_sampled_total",
         "trace_forced_total",
+        # Build identity: one constant gauge whose labels carry the
+        # jax version / serving precision / conv lowering — the three
+        # facts a dashboard needs to split a latency regression by
+        # deploy rather than by time.
+        "build_info",
         # Router flavor (render_router_metrics): routing outcomes and
         # the pool's own counters — labeled by outcome / reason. The
         # pool counters deliberately mirror the event-counter names so
         # a dashboard reads one series whichever process exported it.
         "fleet_requests_total",
         "connections_retired_total",
+        # Scraper-side series (fleet.scraper appends these to the tsdb;
+        # no exporter emits them): per-target scrape failures and
+        # per-round collection wall. Registered here because
+        # METRIC_NAMES is the CLOSED registry for every series the
+        # telemetry plane can write — the exporter-output drift test
+        # checks output ⊆ registry, and the analysis lint checks the
+        # store's series the same way.
+        "scrape_failures_total",
+        "scrape_duration_ms",
     }
     | set(_EVENT_COUNTERS.values())
     # One gauge family per rolling window (quantile-labeled) + its count.
     | set(WINDOW_METRICS)
     | {f"{m}_count" for m in WINDOW_METRICS}
 )
+
+# One HELP string per family the exporters emit — satellite contract:
+# every emitted family carries exactly one # HELP / # TYPE pair.
+_HELP = {
+    "ready": "1 between warmup completing and drain beginning",
+    "uptime_seconds": "process uptime",
+    "window_seq": "rolling-window emission sequence number",
+    "requests_total": "requests by outcome (served/rejected/error)",
+    "serve_queue_depth": "continuous batcher queue depth",
+    "serve_occupancy": "mean dispatched-batch occupancy",
+    "trace_admitted_total": "requests admitted to tracing decisions",
+    "trace_sampled_total": "requests sampled into traces",
+    "trace_forced_total": "SLO-breach forced trace samples",
+    "build_info": "constant 1; labels carry build identity",
+    "fleet_requests_total": "router requests by outcome",
+    "program_compiles_total": "XLA program compiles",
+    "exec_cache_hits_total": "executable cache hits",
+    "exec_cache_misses_total": "executable cache misses",
+    "exec_cache_rejects_total": "executable cache fingerprint rejects",
+    "overloads_total": "admission-bound rejections",
+    "serve_batches_total": "dispatched serving batches",
+    "connections_opened_total": "fresh pooled channels opened",
+    "connections_reused_total": "pooled channel reuses",
+    "connections_retired_total": "pooled channels retired by reason",
+}
+
+
+def _help_for(name: str) -> str:
+    h = _HELP.get(name)
+    if h is not None:
+        return h
+    if name.endswith("_count"):
+        return f"samples in the {name[:-len('_count')]} rolling window"
+    return f"rolling-window quantile gauge over {name} samples"
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _build_info_labels(serve_precision: str, conv_backend: str) -> str:
+    try:
+        import jax
+        jax_version = getattr(jax, "__version__", "unknown")
+    except Exception:  # pragma: no cover - jax is baked into the image
+        jax_version = "unknown"
+    return (
+        f'{{jax_version="{_escape_label(jax_version)}",'
+        f'serve_precision="{_escape_label(serve_precision)}",'
+        f'conv_backend="{_escape_label(conv_backend)}"}}'
+    )
 
 
 def _fmt(v) -> str:
@@ -83,11 +149,13 @@ def _fmt(v) -> str:
 
 def _row(lines: list[str], name: str, value, labels: str = "",
          kind: str | None = None) -> None:
-    """One exposition row (with its ``# TYPE`` line when ``kind`` is
-    given) — the single row builder behind BOTH exporters, so a format
-    change can never diverge them."""
+    """One exposition row (with its ``# HELP``/``# TYPE`` pair when
+    ``kind`` is given — i.e. on the family's FIRST row) — the single row
+    builder behind BOTH exporters, so a format change can never diverge
+    them."""
     full = _PREFIX + name
     if kind is not None:
+        lines.append(f"# HELP {full} {_help_for(name)}")
         lines.append(f"# TYPE {full} {kind}")
     lines.append(f"{full}{labels} {_fmt(value)}")
 
@@ -102,6 +170,12 @@ def render_metrics(service) -> str:
     def row(name: str, value, labels: str = "",
             kind: str | None = None) -> None:
         _row(lines, name, value, labels, kind)
+
+    cfg = getattr(service, "cfg", None)
+    row("build_info", 1, _build_info_labels(
+        getattr(cfg, "serve_precision", "unknown"),
+        getattr(getattr(cfg, "arch", None), "conv_backend", "unknown"),
+    ), kind="gauge")
 
     health = service.health()
     row("ready", health["ready"], kind="gauge")
@@ -137,10 +211,14 @@ def _window_lines(lines: list[str]) -> None:
     router exporters — one formula, bit-equal to the report's)."""
     for metric, summary in sorted(_windows.snapshot().items()):
         full = _PREFIX + metric
+        lines.append(f"# HELP {full} {_help_for(metric)}")
         lines.append(f"# TYPE {full} gauge")
         for q, stat in _QUANTILES:
             lines.append(f'{full}{{q="{q}"}} {_fmt(summary[stat])}')
-        lines.append(f"{_PREFIX}{metric}_count {summary['n']}")
+        count = f"{metric}_count"
+        lines.append(f"# HELP {_PREFIX}{count} {_help_for(count)}")
+        lines.append(f"# TYPE {_PREFIX}{count} gauge")
+        lines.append(f"{_PREFIX}{count} {summary['n']}")
 
 
 def render_router_metrics(router) -> str:
@@ -157,6 +235,10 @@ def render_router_metrics(router) -> str:
         _row(lines, name, value, labels, kind)
 
     st = router.stats()
+    # The router owns no checkpoint: precision/lowering are per-replica
+    # facts its build_info can't claim — "n/a" is the honest value, the
+    # jax version is still the router process's own.
+    row("build_info", 1, _build_info_labels("n/a", "n/a"), kind="gauge")
     row("ready", router.fleet.ready_count() > 0, kind="gauge")
     row("fleet_requests_total", st["routed"], '{outcome="routed"}',
         kind="counter")
@@ -169,6 +251,10 @@ def render_router_metrics(router) -> str:
     row("connections_opened_total", pool.get("opened", 0), kind="counter")
     row("connections_reused_total", pool.get("reused", 0), kind="counter")
     retired = pool.get("retired") or {}
+    lines.append(
+        f"# HELP {_PREFIX}connections_retired_total "
+        f"{_help_for('connections_retired_total')}"
+    )
     lines.append(f"# TYPE {_PREFIX}connections_retired_total counter")
     if retired:
         for reason, n in sorted(retired.items()):

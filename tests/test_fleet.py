@@ -665,18 +665,65 @@ def test_report_folds_connection_events(tmp_path):
 
 
 def test_scale_verdict_units():
-    # No routable replica → add, regardless of latency history.
-    assert scale_verdict(None, 0.0, ready=0) == "add"
-    # SLO breach → add.
-    assert scale_verdict(400.0, 0.0, ready=2, slo_p99_ms=250.0) == "add"
-    # Queue pressure building → add, even under the SLO.
-    assert scale_verdict(50.0, 20.0, ready=2, slo_p99_ms=250.0) == "add"
-    # Oversized: multiple replicas, idle queues, far under SLO → shed.
-    assert scale_verdict(10.0, 0.0, ready=3, slo_p99_ms=250.0) == "shed"
+    # (burn_fast, burn_slow, queue_depth, ready) → verdict.
+    # No routable replica → add, regardless of burn history.
+    assert scale_verdict(None, None, 0.0, 0) == "add"
+    # BOTH windows burning past max_burn → sustained capacity problem.
+    assert scale_verdict(5.0, 2.0, 0.0, 2) == "add"
+    # A fast-window spike alone is a blip, not a capacity problem.
+    assert scale_verdict(5.0, 0.5, 0.0, 2) == "hold"
+    assert scale_verdict(0.5, 5.0, 0.0, 2) == "hold"
+    # An empty window (None) can never justify an add on its own.
+    assert scale_verdict(5.0, None, 0.0, 2) == "hold"
+    # Queue pressure building → add, even with cold burn windows.
+    assert scale_verdict(0.0, 0.0, 20.0, 2) == "add"
+    # Oversized: multiple replicas, idle queues, a slow window that has
+    # burned essentially nothing → shed; honest absence doesn't block.
+    assert scale_verdict(0.0, 0.05, 0.0, 3) == "shed"
+    assert scale_verdict(None, None, 0.0, 3) == "shed"
     # A single replica never sheds below 1.
-    assert scale_verdict(10.0, 0.0, ready=1, slo_p99_ms=250.0) == "hold"
-    # In between → hold.
-    assert scale_verdict(200.0, 2.0, ready=2, slo_p99_ms=250.0) == "hold"
+    assert scale_verdict(0.0, 0.0, 0.0, 1) == "hold"
+    # Budget spend inside the allowed rate → hold.
+    assert scale_verdict(0.8, 0.6, 2.0, 2) == "hold"
+    # A custom max_burn moves the add threshold with it.
+    assert scale_verdict(1.5, 1.5, 0.0, 2, max_burn=2.0) == "hold"
+
+
+def test_router_healthz_reports_roster_summary(tmp_path):
+    """Satellite: GET /healthz answers "is this fleet degraded" without
+    /metrics parsing — healthy/total counts plus the draining flag."""
+    srv_a, port_a, _ = _fake_replica(
+        lambda p, b, h: (200, {"ok": True}, {})
+    )
+    fleet = FakeFleet([Candidate(0, "127.0.0.1", port_a, 0.0)])
+    router = _router(fleet)
+    front = router.make_server("127.0.0.1", 0)
+    threading.Thread(target=front.serve_forever, daemon=True).start()
+    import urllib.error
+    import urllib.request
+
+    url = f"http://127.0.0.1:{front.server_address[1]}/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            doc = json.loads(resp.read())
+        assert doc["ready"] is True and doc["fleet"] is True
+        assert doc["healthy"] == 1 and doc["total"] == 1
+        assert doc["draining"] is False
+        # Degraded roster: candidates gone → 503 with the counts still
+        # readable (the WHY, not just the refusal).
+        fleet.cands = []
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            doc = json.loads(e.read())
+        assert doc["ready"] is False and doc["healthy"] == 0
+    finally:
+        front.shutdown()
+        router.drain()
+        srv_a.shutdown()
 
 
 # --- membership ready-signal re-admission ------------------------------------
@@ -920,3 +967,168 @@ def test_fleet_e2e_replica_loss_zero_drops_cached_rejoin(
                for e in rep["fleet"]["timeline"])
     text = format_report(rep)
     assert "fleet:" in text and "scale verdicts" in text
+
+
+def test_fleet_e2e_burn_rate_scrape_alert_and_dash(
+    fleet_ckpt, tmp_path, capsys
+):
+    """ISSUE 16 acceptance: a real 2-replica CPU fleet with
+    ``replica_slow`` injected on one replica — the scraper populates the
+    run_dir time-series store from all three /metrics endpoints, the
+    burn-rate SLO fires during the slowdown and resolves after recovery,
+    ``fleet_scale`` flips to ``add`` on sustained burn and ``hold``
+    after, and the dashboard + report fleet timeline render from the
+    store ALONE once every serving process has exited."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.fleet.loadgen import http_load, replica_argv
+    from featurenet_tpu.fleet.scraper import ROUTER_TARGET, MetricsScraper
+    from featurenet_tpu.obs import alerts as _alerts
+    from featurenet_tpu.obs import tsdb as _tsdb
+    from featurenet_tpu.obs.dash import render_frame
+    from featurenet_tpu.obs.report import build_report_dir
+
+    run_dir = str(tmp_path / "run")
+    cache_dir = str(tmp_path / "exec_cache")
+    obs.init_run(run_dir, process_index=0,
+                 extra={"cmd": "fleet-e2e-burn"})
+    # The chaos arm rides the CHILD argv: slot 1 sleeps 250 ms on every
+    # forward. Mutable so the recovery respawn comes up clean.
+    fault_for = {1: "replica_slow@request=1:every=1"}
+
+    def spawn(slot, hb):
+        return replica_argv(
+            fleet_ckpt, slot, hb, run_dir=run_dir,
+            exec_cache_dir=cache_dir, buckets="1,2", max_wait_ms=3.0,
+            queue_limit=64, inject_faults=fault_for.get(slot),
+        )
+
+    store = _tsdb.TimeSeriesStore.open(run_dir)
+    # Tight windows so the e2e exercises the real multi-window shape in
+    # seconds. The 200 ms objective sits between the fleet's clean p99
+    # under light CPU load (~tens of ms) and the injected 250 ms
+    # forwards; the fast window proves "now", the slow "sustained".
+    rule = _alerts.BurnRateRule("serving_p99_ms", "<", 200.0, 0.99,
+                                "critical", fast_s=5.0, slow_s=120.0)
+    manager = ReplicaManager(2, spawn, run_dir)
+    # slo_p99_ms=2000 keeps the THRESHOLD alerts (and the drain gate)
+    # out of the story — this test is about the burn layer.
+    router = FleetRouter(manager, slo_p99_ms=2000.0,
+                         scale_every_s=3600.0, store=store, slos=[rule])
+    srv = None
+    try:
+        manager.start()
+        deadline = time.monotonic() + 420
+        while manager.ready_count() < 2:
+            assert time.monotonic() < deadline, \
+                f"fleet warmup timed out: {manager.stats()}"
+            time.sleep(0.25)
+        srv = router.make_server("127.0.0.1", 0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        scraper = MetricsScraper(
+            store, manager.pool,
+            lambda: {
+                **{str(s): p
+                   for s, p in manager.stats()["ports"].items()},
+                ROUTER_TARGET: port,
+            },
+        )
+        router._scale_tick()  # baseline verdict, cold windows
+        grids = generate_batch(
+            np.random.default_rng(0), 16, RES
+        )["voxels"]
+        # --- slowdown: load + scrape until the verdict flips to add ---
+        t_end = time.monotonic() + 240
+        while router._last_verdict != "add":
+            assert time.monotonic() < t_end, (
+                router.scale_state(), scraper.stats())
+            stats, _ = http_load("127.0.0.1", port, qps=80.0,
+                                 n_requests=24, grids=grids)
+            assert stats["dropped"] == 0, stats
+            scraper.scrape_once()
+            router._scale_tick()
+        st_scale = router.scale_state()
+        assert st_scale["burn_fast"] > 1.0, st_scale
+        assert st_scale["burn_slow"] > 1.0, st_scale
+        assert router._burn.active_alerts() == ["serving_p99_ms"]
+        # --- recovery: clear the fault, recycle the slow replica ------
+        del fault_for[1]
+        assert manager.kill_one() == 1  # highest live slot = the slow one
+        t_rejoin = time.monotonic() + 300
+        while manager.ready_count() < 2:
+            assert time.monotonic() < t_rejoin, \
+                f"rejoin timed out: {manager.stats()}"
+            time.sleep(0.25)
+        # Flush the router's 128-sample serving window with fast
+        # traffic, then collect clean rounds: the fast window drains,
+        # the slow window still remembers — resolve + hold, not shed.
+        # Gentle but long: enough requests to flush every 128-sample
+        # window past warmup/slowdown residue, at a rate the CPU fleet
+        # serves WITHIN the objective (a hammering burst would queue its
+        # way over the threshold and look like the outage it is
+        # flushing).
+        stats, _ = http_load("127.0.0.1", port, qps=40.0,
+                             n_requests=300, grids=grids)
+        assert stats["dropped"] == 0, stats
+        # Let the slowdown-era scrapes age out of the FAST window (the
+        # whole injection phase can fit inside it on a warm machine),
+        # then collect rounds that read the now-clean gauges.
+        time.sleep(rule.fast_s + 0.5)
+        for _ in range(3):
+            scraper.scrape_once()
+            time.sleep(0.2)
+        router._scale_tick()
+        st_scale = router.scale_state()
+        assert router._last_verdict == "hold", st_scale
+        assert st_scale["burn_fast"] is not None
+        assert st_scale["burn_fast"] < 1.0, st_scale
+        assert router._burn.active_alerts() == []
+        srv.shutdown()
+        srv = None
+        st = router.drain()
+        assert st["exit_code"] == 0, st
+        st["scrape"] = scraper.stats()
+        assert st["scrape"]["samples"] > 0
+        assert not store.stats()["dark"], store.stats()
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        manager.stop()
+        store.close()
+        obs.close_run()
+    # --- post-hoc, from the run_dir alone -----------------------------------
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    burn_alerts = [e for e in events if e["ev"] == "alert"
+                   and e["rule"] == "serving_p99_ms_burn"]
+    assert [e["state"] for e in burn_alerts] == ["fire", "resolve"], \
+        burn_alerts
+    verdicts = [e["verdict"] for e in events
+                if e["ev"] == "fleet_scale"]
+    assert "add" in verdicts and verdicts[-1] == "hold", verdicts
+    # The store outlived every serving process: all three endpoints'
+    # series are on disk, p99 history included.
+    reader = _tsdb.TimeSeriesStore.open(run_dir)
+    scraped = {lb.get("replica") for _m, lb in reader.series()
+               if lb.get("replica") is not None}
+    assert {"0", "1", ROUTER_TARGET} <= scraped, scraped
+    for target in ("0", "1", ROUTER_TARGET):
+        assert reader.query("serving_ms",
+                            {"q": "0.99", "replica": target}), target
+        assert reader.query("scrape_duration_ms",
+                            {"replica": target}), target
+    # The dashboard renders from the store alone — module and CLI.
+    frame = render_frame(run_dir)
+    assert frame.splitlines()[0].startswith("fleet dash")
+    assert "burn serving_p99_ms" in frame
+    from featurenet_tpu.cli import main as cli_main
+
+    cli_main(["dash", run_dir, "--once"])
+    out = capsys.readouterr().out
+    assert "3 target(s)" in out and "router" in out
+    # And the report's fleet timeline, store-only too.
+    rep = build_report_dir(run_dir)
+    tl = rep.get("fleet_timeline")
+    assert tl and ROUTER_TARGET in tl["targets"]
+    assert tl["targets"]["1"]["samples"] > 0
+    assert "fleet timeline" in format_report(rep)
