@@ -367,6 +367,19 @@ def _measure_round(platform: str) -> dict:
         trace_row = measure_trace_overhead(cfg)
     except Exception as e:
         trace_row = {"trace_overhead_error": repr(e)[:500]}
+    # Model-quality telemetry tax (obs.quality + serve.recorder):
+    # closed-loop rate with the per-request confidence/drift math and
+    # the flight recorder's capture policy attached vs detached, same
+    # session. Pinned (max) under the same "telemetry is never
+    # load-bearing" contract as the trace row; a failure degrades to an
+    # absent key with the error in-artifact.
+    from featurenet_tpu.serve.loadgen import measure_quality_overhead
+
+    quality_row: dict = {}
+    try:
+        quality_row = measure_quality_overhead(cfg)
+    except Exception as e:
+        quality_row = {"quality_overhead_error": repr(e)[:500]}
     # Serving-fleet robustness row (featurenet_tpu.fleet.loadgen): a
     # 2-replica CPU fleet (replicas forced onto JAX_PLATFORMS=cpu —
     # this row pins the ROUTER layer, deliberately independent of
@@ -591,6 +604,10 @@ def _measure_round(platform: str) -> dict:
         # overload rejections.
         **serve_row,
         **trace_row,
+        # Model-quality telemetry tax row (serve.loadgen.
+        # measure_quality_overhead): the quality plane's hot-path cost,
+        # pinned max like trace_overhead_pct.
+        **quality_row,
         # Fleet robustness row (fleet.loadgen.bench_fleet): router-level
         # sustained QPS / p99 through a mid-run replica kill, dropped
         # admitted requests (pinned 0), spillover/re-submit counts.

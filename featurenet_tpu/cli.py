@@ -782,6 +782,39 @@ def main(argv=None) -> None:
                        help="persistent AOT executable cache: the bucket "
                             "ladder's warmup deserializes instead of "
                             "compiling on later cold starts")
+    p_srv.add_argument("--quality", action="store_true",
+                       help="model-quality telemetry (classify "
+                            "checkpoints): per-request top-1 confidence, "
+                            "top1-top2 margin, and prediction entropy "
+                            "feed rolling windows (confidence_p50 etc. "
+                            "in /metrics, dash, and the report) and "
+                            "install the confidence-collapse alert rule")
+    p_srv.add_argument("--quality-baseline", dest="quality_baseline",
+                       help="pinned prediction-mix baseline "
+                            "(quality_baseline.json from `cli "
+                            "pin-quality`): enables --quality and the "
+                            "quality_drift_score windows + drift alert "
+                            "rule — total-variation distance of the "
+                            "rolling predicted-class histogram vs this "
+                            "baseline")
+    p_srv.add_argument("--capture", action="store_true",
+                       help="flight recorder: keep a bounded, sampled "
+                            "JSONL ring of served requests (bit-packed "
+                            "voxels + trace id + prediction + "
+                            "confidence) under <run-dir>/capture; "
+                            "rejections, errors, low-confidence "
+                            "predictions, and SLO breaches are always "
+                            "captured — `cli replay` re-scores the ring "
+                            "against a candidate")
+    p_srv.add_argument("--capture-sample", type=float, default=None,
+                       dest="capture_sample",
+                       help="deterministic capture rate in [0,1] for "
+                            "healthy traffic (trace-id hash, so a fleet "
+                            "agrees without coordination; default 0.05); "
+                            "forced reasons ignore it")
+    p_srv.add_argument("--capture-dir", dest="capture_dir",
+                       help="capture ring directory (default: "
+                            "<run-dir>/capture; implies --capture)")
     p_flt = sub.add_parser("fleet", allow_abbrev=False,
                            help="elastic serving fleet "
                                 "(featurenet_tpu.fleet): N supervised "
@@ -864,6 +897,91 @@ def main(argv=None) -> None:
                             "replica's Nth forward; spawn_fail fires "
                             "in the manager — child-side sites fire in "
                             "the replicas")
+    p_flt.add_argument("--quality", action="store_true",
+                       help="per-replica model-quality telemetry (see "
+                            "`serve --quality`); the scraper folds the "
+                            "confidence windows into the fleet tsdb")
+    p_flt.add_argument("--quality-baseline", dest="quality_baseline",
+                       help="pinned prediction-mix baseline passed to "
+                            "every replica (see `serve "
+                            "--quality-baseline`)")
+    p_flt.add_argument("--capture", action="store_true",
+                       help="per-replica flight recorder: each replica "
+                            "keeps its own ring under "
+                            "<run-dir>/capture/replica<slot> (see "
+                            "`serve --capture`)")
+    p_flt.add_argument("--capture-sample", type=float, default=None,
+                       dest="capture_sample",
+                       help="per-replica capture rate (see `serve "
+                            "--capture-sample`)")
+    p_rpq = sub.add_parser(
+        "pin-quality", allow_abbrev=False,
+        help="pin a predicted-class-mix baseline "
+             "(quality_baseline.json) from an eval pass of a classify "
+             "checkpoint over the synthetic set — the reference "
+             "distribution `serve --quality-baseline` scores live "
+             "traffic against (quality_drift_score = total-variation "
+             "distance, alert rule quality_drift_score_p50>0.25)")
+    p_rpq.add_argument("--checkpoint-dir", required=True)
+    p_rpq.add_argument("--config", default=None,
+                       help="only needed for legacy checkpoints without "
+                            "a persisted config.json")
+    p_rpq.add_argument("--precision", choices=["fp32", "bf16", "int8"],
+                       default=None,
+                       help="score the eval pass at this serving "
+                            "precision (default: the config's "
+                            "serve_precision)")
+    p_rpq.add_argument("--n", type=int, default=512,
+                       help="eval parts to score (default 512)")
+    p_rpq.add_argument("--seed", type=int, default=0,
+                       help="synthetic-set seed (default 0)")
+    p_rpq.add_argument("--batch", type=int, default=32,
+                       help="scoring batch size (default 32)")
+    p_rpq.add_argument("--out", default=None,
+                       help="baseline artifact path (default: "
+                            "<checkpoint-dir>/quality_baseline.json)")
+    p_rpl = sub.add_parser(
+        "replay", allow_abbrev=False,
+        help="replay canary: re-score a flight-recorder capture ring "
+             "(`serve --capture`) against a candidate — a different "
+             "checkpoint, --precision, or --conv-backend — through the "
+             "same AOT serving program path, and report agreement vs "
+             "the recorded predictions, the per-class flip matrix, "
+             "confidence deltas, and scoring latency; EXITS 2 below "
+             "--min-agreement, so CI can gate a rollout on real "
+             "captured traffic")
+    p_rpl.add_argument("capture_dir",
+                       help="capture ring directory (e.g. "
+                            "<run-dir>/capture)")
+    p_rpl.add_argument("--checkpoint-dir", required=True,
+                       help="the CANDIDATE checkpoint to re-score with")
+    p_rpl.add_argument("--config", default=None,
+                       help="only needed for legacy checkpoints without "
+                            "a persisted config.json")
+    p_rpl.add_argument("--precision", choices=["fp32", "bf16", "int8"],
+                       default=None,
+                       help="candidate serving precision (see `infer "
+                            "--precision`)")
+    p_rpl.add_argument("--conv-backend",
+                       choices=["xla", "pallas", "hybrid_dw", "fused33"],
+                       help="candidate conv lowering (non-identity: the "
+                            "same trained weights through a different "
+                            "backend)")
+    p_rpl.add_argument("--batch", type=int, default=32,
+                       help="scoring batch size — one AOT program, "
+                            "built at warmup; replay then runs ZERO "
+                            "compiles (default 32)")
+    p_rpl.add_argument("--min-agreement", type=float, default=0.967,
+                       dest="min_agreement",
+                       help="agreement gate: exit 2 when the candidate "
+                            "matches fewer than this fraction of the "
+                            "ring's recorded predictions (default "
+                            "0.967, the paper's accuracy bar)")
+    p_rpl.add_argument("--run-dir", dest="run_dir",
+                       help="observability directory: the replay_verdict "
+                            "event (agreement, n, ok) lands in this "
+                            "run's stream so the report's quality "
+                            "section shows the canary outcome")
     args = parser.parse_args(argv)
 
     if args.cmd == "programs":
@@ -1572,6 +1690,173 @@ def main(argv=None) -> None:
                 raise SystemExit(2)
         return
 
+    if args.cmd == "pin-quality":
+        import os
+
+        import numpy as np
+
+        from featurenet_tpu.data.synthetic import CLASS_NAMES, generate_batch
+        from featurenet_tpu.infer import Predictor
+        from featurenet_tpu.obs import quality as _quality
+
+        if args.n < 1:
+            raise SystemExit(f"pin-quality: --n must be >= 1, got {args.n}")
+        pred = Predictor.from_checkpoint(
+            args.checkpoint_dir, args.config,
+            batch=min(args.batch, args.n), precision=args.precision,
+        )
+        if pred.cfg.task != "classify":
+            raise SystemExit(
+                "pin-quality: a drift baseline is a predicted-CLASS "
+                f"distribution — task={pred.cfg.task!r} has none"
+            )
+        rng = np.random.default_rng(args.seed)
+        counts = [0] * len(CLASS_NAMES)
+        remaining = args.n
+        while remaining > 0:
+            k = min(remaining, max(args.batch, 1) * 8)
+            grids = generate_batch(rng, k, pred.cfg.resolution)["voxels"]
+            labels, _probs = pred.predict_voxels(grids)
+            for lab in labels.tolist():
+                counts[int(lab)] += 1
+            remaining -= k
+        out = args.out or os.path.join(
+            args.checkpoint_dir, _quality.BASELINE_FILENAME
+        )
+        rec = _quality.save_baseline(
+            out, counts, class_names=list(CLASS_NAMES),
+            source={"checkpoint_dir": args.checkpoint_dir,
+                    "n": args.n, "seed": args.seed,
+                    "precision": pred.precision},
+        )
+        top = sorted(range(len(rec["dist"])),
+                     key=lambda i: -rec["dist"][i])[:5]
+        print(json.dumps({"quality_baseline": {
+            "path": out, "n": rec["n"],
+            "top": [{"class": CLASS_NAMES[i], "p": rec["dist"][i]}
+                    for i in top],
+        }}))
+        return
+
+    if args.cmd == "replay":
+        import shutil
+        import tempfile
+
+        import numpy as np
+
+        from featurenet_tpu import obs
+        from featurenet_tpu.config import get_config
+        from featurenet_tpu.data.synthetic import CLASS_NAMES
+        from featurenet_tpu.infer import Predictor
+        from featurenet_tpu.obs import events as _events
+        from featurenet_tpu.serve.recorder import read_captures, unpack_grid
+        from featurenet_tpu.train.checkpoint import load_run_config
+
+        if not (0.0 <= args.min_agreement <= 1.0):
+            raise SystemExit(
+                f"replay: --min-agreement must be in [0, 1], got "
+                f"{args.min_agreement}"
+            )
+        # Only answered requests carry a recorded prediction to agree
+        # with; rejection/error captures are evidence for humans, not
+        # for the canary.
+        recs = [r for r in read_captures(args.capture_dir)
+                if r.get("label") is not None]
+        if not recs:
+            raise SystemExit(
+                f"replay: no re-scorable capture records under "
+                f"{args.capture_dir!r} — the ring is missing, empty, or "
+                "holds only rejections/errors"
+            )
+        saved = load_run_config(args.checkpoint_dir)
+        cfg = _apply_arch_overrides(
+            saved if saved is not None
+            else get_config(args.config or "pod64"),
+            args,
+        )
+        grids = np.stack([unpack_grid(r["voxels"]) for r in recs])
+        # The replay sink: the verdict event needs a live stream and the
+        # zero-compile evidence needs the sink's program_compile counter
+        # — a throwaway run_dir serves both when the operator gave none.
+        own_run = not getattr(args, "run_dir", None)
+        run_dir = args.run_dir or tempfile.mkdtemp(prefix="replay_")
+        obs.init_run(run_dir, extra={"cmd": "replay"})
+        try:
+            # Construction is the warmup: ONE program at the scoring
+            # batch builds (or loads from the exec cache) here — every
+            # compile after this point is a canary failure in itself.
+            pred = Predictor.from_checkpoint(
+                args.checkpoint_dir, cfg,
+                batch=min(args.batch, len(recs)),
+                precision=args.precision,
+            )
+            if pred.cfg.task != "classify":
+                raise SystemExit(
+                    "replay: capture rings hold classify traffic — the "
+                    f"candidate is task={pred.cfg.task!r}"
+                )
+            warm = _events.kind_counts().get("program_compile", 0)
+            t0 = time.perf_counter()
+            labels, probs = pred.predict_voxels(grids)
+            score_ms = (time.perf_counter() - t0) * 1e3
+            compiles = (
+                _events.kind_counts().get("program_compile", 0) - warm
+            )
+
+            def _cls(c: int) -> str:
+                return CLASS_NAMES[c] if 0 <= c < len(CLASS_NAMES) \
+                    else str(c)
+
+            n = len(recs)
+            agree = 0
+            flips: dict = {}
+            conf_deltas = []
+            for i, r in enumerate(recs):
+                old, new = int(r["label"]), int(labels[i])
+                if old == new:
+                    agree += 1
+                else:
+                    key = f"{_cls(old)}->{_cls(new)}"
+                    flips[key] = flips.get(key, 0) + 1
+                if r.get("confidence") is not None:
+                    conf_deltas.append(
+                        float(probs[i, new]) - float(r["confidence"])
+                    )
+            agreement = agree / n
+            ok = agreement >= args.min_agreement
+            obs.emit("replay_verdict", agreement=round(agreement, 6),
+                     n=n, ok=ok, min_agreement=args.min_agreement,
+                     flips=sum(flips.values()),
+                     post_warmup_compiles=compiles)
+            print(json.dumps({"replay": {
+                "capture_dir": args.capture_dir,
+                "candidate": {
+                    "checkpoint_dir": args.checkpoint_dir,
+                    "precision": pred.precision,
+                    "conv_backend": pred.cfg.arch.conv_backend,
+                },
+                "n": n,
+                "agreement": round(agreement, 6),
+                "min_agreement": args.min_agreement,
+                "ok": ok,
+                "flips": dict(sorted(flips.items(),
+                                     key=lambda kv: -kv[1])),
+                "confidence_delta": {
+                    "mean": round(sum(conf_deltas) / len(conf_deltas), 6),
+                    "max_abs": round(max(abs(d) for d in conf_deltas), 6),
+                } if conf_deltas else None,
+                "score_ms": round(score_ms, 3),
+                "per_request_ms": round(score_ms / n, 3),
+                "post_warmup_compiles": compiles,
+            }}))
+        finally:
+            obs.close_run()
+            if own_run:
+                shutil.rmtree(run_dir, ignore_errors=True)
+        if not ok:
+            raise SystemExit(2)
+        return
+
     if args.cmd == "serve":
         import dataclasses as _dc
         import signal
@@ -1640,12 +1925,60 @@ def main(argv=None) -> None:
             args.checkpoint_dir, cfg, batch=max(buckets),
             precision=args.precision,
         )
+        want_quality = args.quality or bool(args.quality_baseline)
+        want_capture = (args.capture or bool(args.capture_dir)
+                        or args.capture_sample is not None)
+        if (want_quality or want_capture) and pred.cfg.task != "classify":
+            raise SystemExit(
+                "serve: --quality/--capture need a classify checkpoint "
+                f"(task={pred.cfg.task!r}) — confidence and drift are "
+                "class-probability notions"
+            )
+        quality = None
+        if want_quality:
+            from featurenet_tpu.data.synthetic import CLASS_NAMES
+            from featurenet_tpu.obs.quality import (
+                QualityTracker,
+                load_baseline,
+            )
+
+            baseline = None
+            if args.quality_baseline:
+                try:
+                    baseline = load_baseline(args.quality_baseline)["dist"]
+                except (OSError, ValueError) as e:
+                    raise SystemExit(f"--quality-baseline: {e}")
+            quality = QualityTracker(len(CLASS_NAMES), baseline=baseline)
+        recorder = None
+        if want_capture:
+            from featurenet_tpu.serve import recorder as _recorder
+
+            root = args.capture_dir
+            if not root:
+                if not getattr(args, "run_dir", None):
+                    raise SystemExit(
+                        "serve: --capture needs --run-dir (or an "
+                        "explicit --capture-dir) — the ring has to "
+                        "live somewhere"
+                    )
+                root = _recorder.capture_dir(args.run_dir)
+            try:
+                recorder = _recorder.FlightRecorder(
+                    root,
+                    sample=(_recorder.DEFAULT_SAMPLE
+                            if args.capture_sample is None
+                            else args.capture_sample),
+                    slo_ms=args.slo_p99_ms,
+                )
+            except ValueError as e:
+                raise SystemExit(f"--capture-sample: {e}")
         service = InferenceService(
             pred, buckets=buckets, max_wait_ms=args.max_wait_ms,
             queue_limit=args.queue_limit, rules=rules,
             slo_p99_ms=args.slo_p99_ms,
             batch_queue_limit=args.batch_queue_limit,
             replica=args.replica_id,
+            quality=quality, recorder=recorder,
         )
         hb_stop = threading.Event()
         if args.heartbeat_file:
@@ -1673,6 +2006,9 @@ def main(argv=None) -> None:
             "queue_limit": args.queue_limit, "precision": pred.precision,
             "trace_sample": cfg.trace_sample,
             "replica": args.replica_id,
+            "quality": (None if quality is None
+                        else {"baseline": quality.baseline is not None}),
+            "capture": None if recorder is None else recorder.root,
             "endpoints": _ENDPOINTS,
         }}), flush=True)
         stop = threading.Event()
@@ -1736,6 +2072,15 @@ def main(argv=None) -> None:
                                only={"replica_loss", "spawn_fail"})
             except ValueError as e:
                 raise SystemExit(f"--inject-faults: {e}")
+        if getattr(args, "quality_baseline", None):
+            # Config-time refusal, like --slos: a malformed baseline
+            # must fail the launcher here, not every replica spawn.
+            from featurenet_tpu.obs.quality import load_baseline
+
+            try:
+                load_baseline(args.quality_baseline)
+            except (OSError, ValueError) as e:
+                raise SystemExit(f"--quality-baseline: {e}")
         obs.init_run(args.run_dir, extra={"cmd": "fleet"},
                      process_index=0)
 
@@ -1748,6 +2093,10 @@ def main(argv=None) -> None:
                 slo_p99_ms=args.slo_p99_ms, precision=args.precision,
                 inject_faults=args.inject_faults,
                 trace_sample=args.trace_sample,
+                quality=args.quality,
+                quality_baseline=args.quality_baseline,
+                capture=args.capture,
+                capture_sample=args.capture_sample,
             )
 
         manager = ReplicaManager(args.replicas, spawn, args.run_dir,

@@ -188,12 +188,18 @@ def replica_argv(ckpt_dir: str, slot: int, heartbeat_file: str, *,
                  slo_p99_ms: float = 250.0,
                  precision: Optional[str] = None,
                  inject_faults: Optional[str] = None,
-                 trace_sample: Optional[float] = None) -> list:
+                 trace_sample: Optional[float] = None,
+                 quality: bool = False,
+                 quality_baseline: Optional[str] = None,
+                 capture: bool = False,
+                 capture_sample: Optional[float] = None) -> list:
     """One replica's spawn argv (shared by ``cli fleet`` and
     ``bench_fleet`` so the two can never drift on the child contract):
     ``cli serve --port 0`` with the fleet identity flags — replica id,
     per-slot heartbeat file, per-slot event stream (``--process-index
-    slot+1``; the router owns stream 0)."""
+    slot+1``; the router owns stream 0). Capture rings are per-slot
+    (``<run_dir>/capture/replica<slot>``): the recorder's segment
+    arithmetic assumes one writer process per directory."""
     argv = [
         sys.executable, "-m", "featurenet_tpu.cli", "serve",
         "--checkpoint-dir", ckpt_dir, "--port", "0",
@@ -214,6 +220,15 @@ def replica_argv(ckpt_dir: str, slot: int, heartbeat_file: str, *,
         argv += ["--inject-faults", inject_faults]
     if trace_sample is not None:
         argv += ["--trace-sample", str(trace_sample)]
+    if quality or quality_baseline:
+        argv += ["--quality"]
+    if quality_baseline:
+        argv += ["--quality-baseline", quality_baseline]
+    if capture and run_dir:
+        argv += ["--capture-dir",
+                 os.path.join(run_dir, "capture", f"replica{slot}")]
+        if capture_sample is not None:
+            argv += ["--capture-sample", str(capture_sample)]
     return argv
 
 

@@ -114,7 +114,28 @@ def _split_label_pairs(body: str) -> list[str]:
 
 
 def _unescape(v: str) -> str:
-    return v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
+    """One left-to-right pass over the exposition escapes (``\\\\``,
+    ``\\"``, ``\\n``). Sequential ``str.replace`` calls would corrupt
+    values where an escaped backslash precedes an ``n`` or a quote —
+    ``a\\\\nb`` (backslash then letter n) must round-trip as-is, not
+    collapse into a newline."""
+    out = []
+    i = 0
+    while i < len(v):
+        ch = v[i]
+        if ch == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt in ('\\', '"'):
+                out.append(nxt)
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
 
 
 class MetricsScraper:

@@ -71,6 +71,18 @@ WINDOW_METRICS = (
                         # peak; no samples on the `unknown` peak tier)
     "achieved_bw_fraction",  # per-dispatch bytes-accessed over wall over
                         # the device-kind peak HBM bandwidth (obs.perf)
+    "confidence",       # per-request top-1 softmax probability (model
+                        # quality: a collapsing p50 is the model losing
+                        # its grip before accuracy can be measured)
+    "confidence_margin",  # per-request top1−top2 probability gap — the
+                        # escalation signal the adaptive-resolution
+                        # cascade reads (near-zero = ambiguous input)
+    "prediction_entropy",  # per-request softmax entropy in nats (uniform
+                        # over 24 classes ≈ 3.18; near-zero = peaked)
+    "quality_drift_score",  # total-variation distance of the rolling
+                        # predicted-class histogram vs the pinned
+                        # baseline distribution (obs.quality; 0 = same
+                        # mix, 1 = disjoint)
 )
 
 _WINDOW_STATS = ("p50", "p95", "p99", "max", "mean")

@@ -39,6 +39,7 @@ _COLUMNS = (
     ("serve_p99_ms", "p99_ms", "{:.1f}"),
     ("ttfs_warm_s", "ttfs_w", "{:.1f}"),
     ("trace_overhead_pct", "trace_%", "{:.1f}"),
+    ("quality_overhead_pct", "qual_%", "{:.1f}"),
     ("fleet_qps_sustained", "qps_fleet", "{:.0f}"),
     ("fleet_p99_ms", "fl_p99", "{:.1f}"),
     ("fleet_requests_dropped", "fl_drop", "{:.0f}"),

@@ -17,6 +17,7 @@ ANSI clear + redraw keeps it dumb enough to pipe.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -112,6 +113,20 @@ def render_frame(run_dir: str, *, window_s: float = DEFAULT_WINDOW_S,
         now = time.time()
     store = _tsdb.TimeSeriesStore.open(run_dir)
     targets = _replica_targets(store)
+    if not store.series():
+        # Friendly empty state instead of a meaningless table: a brand
+        # new run_dir, a typo'd path, and a finished-but-never-scraped
+        # run all land here (the store absorbs the missing directory).
+        where = _tsdb.store_dir(run_dir)
+        why = ("no such directory" if not os.path.isdir(where)
+               else "no samples yet")
+        return (
+            f"fleet dash · {run_dir} · 0 target(s)\n"
+            f"no telemetry series under {where} ({why}) — the store is "
+            f"populated by the fleet scraper (`cli fleet --run-dir`), "
+            f"so point dash at a fleet run_dir or wait for the first "
+            f"scrape round\n"
+        )
     lines = [
         f"fleet dash · {run_dir} · window {window_s:g}s · "
         f"{len(targets)} target(s)",
@@ -168,6 +183,35 @@ def render_frame(run_dir: str, *, window_s: float = DEFAULT_WINDOW_S,
             f"{rule.objective * 100:g}%): fast {_fmt(fast, 2)}  "
             f"slow {_fmt(slow, 2)}  [{state}]"
         )
+
+    # Model-quality panel: only when the quality plane is on (some
+    # target exported confidence windows into the store). Confidence
+    # p50 collapsing and the drift score rising are exactly the two
+    # lines the quality alert rules watch.
+    q_rows = []
+    for target in targets:
+        conf_s = store.query("confidence", {"q": "0.5", "replica": target},
+                             since_s=window_s, now=now)
+        drift_s = store.query("quality_drift_score",
+                              {"q": "0.5", "replica": target},
+                              since_s=window_s, now=now)
+        if not conf_s and not drift_s:
+            continue
+        conf = _bucket(conf_s, now, window_s)
+        drift = _bucket(drift_s, now, window_s)
+        last_c = next((v for v in reversed(conf) if v is not None), None)
+        last_d = next((v for v in reversed(drift) if v is not None), None)
+        q_rows.append(
+            f"{target:<8} {_spark(conf)} {_fmt(last_c, 3):>7}  "
+            f"{_spark(drift)} {_fmt(last_d, 3):>7}"
+        )
+    if q_rows:
+        lines.append("")
+        lines.append(
+            f"{'quality':<8} {'confidence p50':<{SPARK_SLOTS + 9}} "
+            f"{'drift p50':<{SPARK_SLOTS + 9}}"
+        )
+        lines.extend(q_rows)
 
     # Fleet-level channel reuse (router counters) + roster + collection
     # health.

@@ -127,6 +127,13 @@ DIRECTIONS = {
     # cost; the pin is what enforces "never load-bearing" as a measured
     # property rather than a docstring claim.
     "trace_overhead_pct": "max",
+    # Model-quality telemetry tax (serve.loadgen.
+    # measure_quality_overhead): quality-plane-on vs detached closed-
+    # loop rate through one warmed service — per-request confidence
+    # math, drift scoring, and the flight recorder's capture policy
+    # must never silently grow a hot-path cost, same contract as
+    # trace_overhead_pct.
+    "quality_overhead_pct": "max",
     # Telemetry-collection tax (fleet.loadgen.bench_fleet): open-loop
     # fleet qps with the scraper collecting vs paused, same warm fleet.
     # Regresses UPWARD for the same reason as trace_overhead_pct —
@@ -268,6 +275,7 @@ BENCH_GATE_KEYS = (
     "serve_occupancy",
     "serve_rejected",
     "trace_overhead_pct",
+    "quality_overhead_pct",
     # Scaling-efficiency gate: samples/sec per mesh shape plus the
     # cross-host data-wait spread of the 2-host probe run — present only
     # when the round could measure them (device count / probe success),
@@ -348,6 +356,9 @@ NOISY_KEY_ABS_SLACK = {
     "serve_client_p99_ms": 15.0,
     "serve_rejected": 16.0,
     "trace_overhead_pct": 10.0,
+    # The quality tax rides the same closed-loop A/B as the trace tax
+    # and inherits its run-to-run noise floor — same absolute room.
+    "quality_overhead_pct": 10.0,
     "data_wait_spread": 0.1,
     "fleet_p99_ms": 25.0,
     "fleet_conn_reuse_ratio": 0.05,

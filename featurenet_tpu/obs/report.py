@@ -810,6 +810,52 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             serve["rejected"] = stops[-1].get("rejected")
         rep["serve"] = serve
 
+    # --- model quality (obs.quality / serve.recorder / cli replay) -----------
+    # The quality plane's fold: confidence/entropy/drift windows (already
+    # summarized by the SLO section — mirrored here so the model's story
+    # reads in one place), the drift snapshots' trajectory, what the
+    # flight recorder kept and why, and the latest replay-canary verdict.
+    # Every host's stream counts: each replica tracks its own mix.
+    quality: dict = {}
+    qwin = slo.get("windows") or {}
+    for metric, out_key in (("confidence", "confidence"),
+                            ("confidence_margin", "margin"),
+                            ("prediction_entropy", "entropy"),
+                            ("quality_drift_score", "drift_score")):
+        row = qwin.get(metric)
+        if row:
+            quality[out_key] = row
+    qd = [e for e in events if e["ev"] == "quality_drift"]
+    if qd:
+        scores = [e.get("score") for e in qd
+                  if isinstance(e.get("score"), (int, float))]
+        quality["drift"] = {
+            "snapshots": len(qd),
+            "last_score": qd[-1].get("score"),
+            "max_score": round(max(scores), 6) if scores else None,
+        }
+    caps = [e for e in events if e["ev"] == "capture"]
+    if caps:
+        by_reason: dict[str, int] = {}
+        for e in caps:
+            r = str(e.get("reason", "?"))
+            by_reason[r] = by_reason.get(r, 0) + 1
+        quality["captures"] = {
+            "count": len(caps),
+            "by_reason": dict(sorted(by_reason.items())),
+        }
+    rv = [e for e in events if e["ev"] == "replay_verdict"]
+    if rv:
+        last_v = rv[-1]
+        quality["replay"] = {
+            "runs": len(rv),
+            "agreement": last_v.get("agreement"),
+            "n": last_v.get("n"),
+            "ok": last_v.get("ok"),
+        }
+    if quality:
+        rep["quality"] = quality
+
     # --- persistent-connection data plane (fleet.pool) ------------------------
     # Channel lifecycle events, merged across streams: opened vs reused
     # is the pooling payoff (reuse_ratio — the bench gate pins the fleet
@@ -1253,6 +1299,40 @@ def format_report(rep: dict) -> str:
                     f"{k}×{v}" for k, v in se["by_bucket"].items()
                 )
             )
+    qa = rep.get("quality")
+    if qa:
+        conf = qa.get("confidence")
+        dw = qa.get("drift_score")
+        head = "quality:"
+        if conf:
+            head += (f" confidence p50 {conf.get('p50')} "
+                     f"p99 {conf.get('p99')} (n={conf.get('n')})")
+        if qa.get("entropy"):
+            head += f", entropy p50 {qa['entropy'].get('p50')}"
+        if dw:
+            head += f", drift p50 {dw.get('p50')} max {dw.get('max')}"
+        lines.append(head)
+        dr = qa.get("drift")
+        if dr:
+            lines.append(
+                f"  drift: {dr['snapshots']} snapshot(s), "
+                f"last {dr.get('last_score')}, max {dr.get('max_score')}"
+            )
+        cp = qa.get("captures")
+        if cp:
+            lines.append(
+                f"  captures: {cp['count']}"
+                + (" (" + ", ".join(
+                    f"{k}×{v}" for k, v in cp["by_reason"].items()
+                   ) + ")" if cp.get("by_reason") else "")
+            )
+        rp = qa.get("replay")
+        if rp:
+            lines.append(
+                f"  replay: {rp['runs']} run(s), last agreement "
+                f"{rp.get('agreement')} over {rp.get('n')} request(s) "
+                f"({'ok' if rp.get('ok') else 'BELOW GATE'})"
+            )
     fl = rep.get("fleet")
     if fl:
         lines.append(
@@ -1598,6 +1678,14 @@ KNOWN_EVENT_KINDS = frozenset({
     # retired with its reason (broken / max_age / idle_overflow /
     # server_close / probe_failure / replica_loss / shutdown).
     "conn_open", "conn_reuse", "conn_retire",
+    # Model-quality plane (obs.quality / serve.recorder / cli replay):
+    # a rolling prediction-mix drift snapshot (TV score of the live
+    # predicted-class histogram vs the pinned baseline), one request
+    # captured into the flight-recorder ring (with the reason it was
+    # kept — sampled, or forced: low_confidence / rejected /
+    # slo_breach), and a replay canary's verdict (agreement of a
+    # candidate against a recorded capture ring).
+    "quality_drift", "capture", "replay_verdict",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -1646,6 +1734,9 @@ REQUIRED_EVENT_FIELDS = {
     "conn_open": ("endpoint",),
     "conn_reuse": ("endpoint",),
     "conn_retire": ("endpoint", "reason"),
+    "quality_drift": ("score", "n"),
+    "capture": ("trace", "reason"),
+    "replay_verdict": ("agreement", "n", "ok"),
 }
 
 # The event kinds that carry a per-request ``trace`` id — the timeline

@@ -205,7 +205,9 @@ class ContinuousBatcher:
                  peaks: Optional[dict] = None,
                  trace_sample: float = 1.0,
                  trace_slo_ms: Optional[float] = None,
-                 lane_limits: Optional[dict] = None):
+                 lane_limits: Optional[dict] = None,
+                 on_result: Optional[Callable] = None,
+                 on_reject: Optional[Callable] = None):
         bs = normalize_buckets(buckets)
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
@@ -241,6 +243,15 @@ class ContinuousBatcher:
             )
         self.trace_sample = float(trace_sample)
         self.trace_slo_ms = trace_slo_ms
+        # Observation hooks, injected to keep the batcher backend-free
+        # (the service binds quality telemetry + the flight recorder):
+        # ``on_result(p, row, total_ms, outcome)`` per de-muxed request
+        # (row is None on a forward error), ``on_reject(p)`` per
+        # admission rejection. Both are telemetry — an exception inside
+        # one is counted and swallowed, never surfaced to the caller.
+        self.on_result = on_result
+        self.on_reject = on_reject
+        self._hook_errors = 0
         self.buckets = bs
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.queue_limit = int(queue_limit)
@@ -320,6 +331,11 @@ class ContinuousBatcher:
             # Rejections are always sampled (tail bias): the structured
             # trace is exactly what the operator chases after a 503.
             _tracing.reject(ctx, depth, self.queue_limit)
+            if self.on_reject is not None:
+                try:
+                    self.on_reject(p)
+                except Exception:
+                    self._hook_errors += 1
             raise OverloadError(depth, self.queue_limit,
                                 trace_id=ctx.trace_id, lane=lane,
                                 retry_after_s=self.retry_after_s)
@@ -424,6 +440,16 @@ class ContinuousBatcher:
                 outcome="error" if err is not None else "ok",
                 slo_ms=self.trace_slo_ms,
             )
+            if self.on_result is not None:
+                try:
+                    self.on_result(
+                        p,
+                        None if err is not None else out[i],
+                        (t_done - p.t_enq) * 1e3,
+                        "error" if err is not None else "ok",
+                    )
+                except Exception:
+                    self._hook_errors += 1
         with self._cv:
             self._batches += 1
             self._rows += n

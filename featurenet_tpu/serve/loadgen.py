@@ -362,3 +362,81 @@ def measure_trace_overhead(cfg, n_requests: int = 192,
         "trace_sampled_qps": round(traced, 1),
         "trace_overhead_requests": n_requests,
     }
+
+
+def measure_quality_overhead(cfg, n_requests: int = 192,
+                             buckets: Sequence[int] = (1, 4, 16),
+                             run_dir: Optional[str] = None) -> dict:
+    """The model-quality telemetry tax, measured: closed-loop request
+    rate through one warmed service with the quality plane OFF (the
+    batcher's result hook detached — the zero-cost default every
+    non-``--quality`` serve runs) vs ON (confidence/margin/entropy
+    windows + drift score against a pinned uniform baseline + the
+    flight recorder at its default sample rate), same session so the
+    executables are identical. The returned ``quality_overhead_pct`` is
+    pinned (max) in the bench gate: per-request quality math and
+    capture must never silently grow a hot-path cost. The recorder's
+    confidence floor is 0 for the probe — a random-init model predicts
+    at ~uniform confidence, and force-capturing every request would
+    measure disk bandwidth, not the telemetry tax on healthy traffic."""
+    import shutil
+    import tempfile
+
+    from featurenet_tpu.data.synthetic import CLASS_NAMES
+    from featurenet_tpu.obs.quality import QualityTracker
+    from featurenet_tpu.serve.recorder import FlightRecorder, capture_dir
+
+    if obs.active():
+        raise RuntimeError(
+            "measure_quality_overhead installs and closes its own obs "
+            "run; close_run() the active run first"
+        )
+    tmp = run_dir or tempfile.mkdtemp(prefix="quality_overhead_")
+    obs.init_run(tmp, extra={"cmd": "quality_overhead"}, process_index=0)
+    num_classes = len(CLASS_NAMES)
+    quality = QualityTracker(
+        num_classes, baseline=[1.0 / num_classes] * num_classes
+    )
+    recorder = FlightRecorder(capture_dir(tmp), confidence_floor=0.0)
+    # rules=() / slo inf, exactly like the trace probe: this measures
+    # the per-request quality math + capture policy, not the alert
+    # engine or forced SLO-breach sampling.
+    service = _build_service(
+        cfg, buckets, max_wait_ms=2.0,
+        queue_limit=max(256, n_requests), rules=(),
+        slo_p99_ms=float("inf"),
+        quality=quality, recorder=recorder,
+    )
+    grid = np.zeros((cfg.resolution,) * 3 + (1,), np.float32)
+
+    def closed_loop_qps() -> float:
+        t0 = time.perf_counter()
+        futs = [service.submit_voxels(grid) for _ in range(n_requests)]
+        for f in futs:
+            f.result(timeout=120.0)
+        return n_requests / (time.perf_counter() - t0)
+
+    hooks = (service.batcher.on_result, service.batcher.on_reject)
+    try:
+        service.batcher.trace_sample = 0.0   # isolate from the trace tax
+        service.batcher.on_result = None     # quality plane detached
+        service.batcher.on_reject = None
+        closed_loop_qps()                    # JIT/page-cache warmup
+        off = closed_loop_qps()
+        service.batcher.on_result, service.batcher.on_reject = hooks
+        on = closed_loop_qps()
+        captured = recorder.stats()["captured"]
+    finally:
+        service.drain()
+        obs.close_run()
+        if run_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "quality_overhead_pct": round(
+            max(0.0, (off - on) / off * 100.0), 2
+        ) if off > 0 else None,
+        "quality_off_qps": round(off, 1),
+        "quality_on_qps": round(on, 1),
+        "quality_overhead_requests": n_requests,
+        "quality_captured": captured,
+    }

@@ -144,7 +144,16 @@ def _fmt(v) -> str:
         return "1" if v else "0"
     if isinstance(v, int):
         return str(v)
-    return format(float(v), "g")
+    f = float(v)
+    # Prometheus exposition spells non-finite samples "NaN"/"+Inf"/"-Inf"
+    # — Python's "nan"/"inf" would be rejected by conformant scrapers.
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return format(f, "g")
 
 
 def _row(lines: list[str], name: str, value, labels: str = "",
@@ -260,7 +269,7 @@ def render_router_metrics(router) -> str:
         for reason, n in sorted(retired.items()):
             lines.append(
                 f'{_PREFIX}connections_retired_total'
-                f'{{reason="{reason}"}} {_fmt(n)}'
+                f'{{reason="{_escape_label(reason)}"}} {_fmt(n)}'
             )
     else:
         lines.append(f"{_PREFIX}connections_retired_total 0")

@@ -87,10 +87,25 @@ class InferenceService:
                  slo_p99_ms: float = DEFAULT_SLO_P99_MS,
                  emit_every_s: float = _windows.DEFAULT_EMIT_EVERY_S,
                  batch_queue_limit: Optional[int] = None,
-                 replica: Optional[str] = None):
+                 replica: Optional[str] = None,
+                 quality=None,
+                 recorder=None):
         self.predictor = predictor
         self.cfg = predictor.cfg
         self.buckets = normalize_buckets(buckets)
+        # Model-quality plane (obs.quality / serve.recorder), both
+        # optional: a QualityTracker feeding the confidence/drift
+        # windows per answered request, and a FlightRecorder keeping a
+        # replayable capture ring. Classification only — a segmentation
+        # row is a label grid, not a probability vector.
+        if (quality is not None or recorder is not None) \
+                and self.cfg.task != "classify":
+            raise ValueError(
+                "quality telemetry and the flight recorder need a "
+                f"classify checkpoint, got task={self.cfg.task!r}"
+            )
+        self.quality = quality
+        self.recorder = recorder
         # The replica's name in a fleet (None when standalone): echoed in
         # overload error bodies and /healthz so a router — or a client
         # reading a 503 — can say WHICH backend rejected it.
@@ -117,6 +132,15 @@ class InferenceService:
         }
         if rules is None:
             rules = serve_rules(slo_p99_ms)
+            if quality is not None:
+                from featurenet_tpu.obs.quality import quality_rules
+
+                # Confidence collapse always; drift only when a baseline
+                # is pinned (a drift rule with nothing to drift FROM
+                # would never see a sample and never fire or resolve).
+                rules = list(rules) + list(quality_rules(
+                    with_drift=quality.baseline is not None
+                ))
         if rules:
             _windows.install(_windows.WindowAggregator(
                 rules=list(rules), emit_every_s=emit_every_s
@@ -139,6 +163,9 @@ class InferenceService:
             # much as the p99).
             trace_sample=getattr(self.cfg, "trace_sample", 1.0),
             trace_slo_ms=float(slo_p99_ms),
+            on_result=self._on_result
+            if (quality is not None or recorder is not None) else None,
+            on_reject=self._on_reject if recorder is not None else None,
         )
         obs.emit("serve_start", buckets=list(self.buckets),
                  max_wait_ms=float(max_wait_ms), queue_limit=int(queue_limit))
@@ -155,6 +182,35 @@ class InferenceService:
             time.sleep(faults.SLOW_SLEEP_S)
         # lint: allow-host-sync(the readback IS the served response)
         return np.asarray(self.predictor.forward_padded(padded, batch=bucket))
+
+    # -- model-quality hooks (batcher callbacks; telemetry, never
+    # load-bearing — the batcher swallows anything these raise) --------------
+    def _on_result(self, p, row, total_ms: float, outcome: str) -> None:
+        """Per answered request: reduce the probability row to floats,
+        feed the quality tracker, and offer the request to the flight
+        recorder. Runs on the single dispatcher thread."""
+        confidence = label = None
+        if row is not None:
+            from featurenet_tpu.obs.quality import confidence_stats
+
+            # lint: allow-host-sync(row is a host array post-readback)
+            probs = np.asarray(row, np.float32)
+            label = int(probs.argmax())
+            confidence, margin, entropy = confidence_stats(probs.tolist())
+            if self.quality is not None:
+                self.quality.observe(label, confidence, margin, entropy)
+        if self.recorder is not None:
+            self.recorder.maybe_capture(
+                p.voxels, p.trace_id, label=label, confidence=confidence,
+                total_ms=total_ms, outcome=outcome,
+            )
+
+    def _on_reject(self, p) -> None:
+        """Per admission rejection: rejected requests are always worth a
+        capture — they are what the operator replays after a 503 storm."""
+        self.recorder.maybe_capture(
+            p.voxels, p.trace_id, outcome="rejected",
+        )
 
     # -- request entry points ------------------------------------------------
     def submit_voxels(self, grid: np.ndarray,
@@ -230,7 +286,12 @@ class InferenceService:
 
     # -- lifecycle -----------------------------------------------------------
     def stats(self) -> dict:
-        return self.batcher.stats()
+        st = self.batcher.stats()
+        if self.quality is not None:
+            st["quality"] = self.quality.stats()
+        if self.recorder is not None:
+            st["capture"] = self.recorder.stats()
+        return st
 
     def ready(self) -> bool:
         """True only between warmup completing and drain beginning —
@@ -263,6 +324,11 @@ class InferenceService:
         self._ready = False
         st = self.batcher.drain(timeout_s)
         _windows.flush()
+        if self.recorder is not None:
+            self.recorder.close()
+            st["capture"] = self.recorder.stats()
+        if self.quality is not None:
+            st["quality"] = self.quality.stats()
         active = [
             m for m in _windows.active_alerts()
             if _alerts.is_serving_metric(m)

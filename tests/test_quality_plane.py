@@ -1,0 +1,588 @@
+"""Model-quality observability plane (ISSUE 17): confidence/drift
+telemetry (obs.quality), the flight-recorder capture ring
+(serve.recorder), the quality alert pair firing and resolving through
+the window hysteresis engine, the report's quality section, the dash
+quality panel and friendly empty state, and the ``cli pin-quality`` /
+``cli replay`` canary loop (agreement gate, zero post-warmup compiles,
+exit 2 below the gate).
+
+The acceptance spine: a served run with a skewed class mix pushes the
+TV drift score over the ceiling and the ``quality_drift_score_p50``
+alert fires; the mix returning to baseline resolves it — one hysteresis
+pair, visible in the report. A capture ring replayed against the bf16
+candidate of the same checkpoint reports full agreement with zero
+compiles after warmup; a ring whose recorded labels disagree with the
+candidate exits 2.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from featurenet_tpu import obs
+from featurenet_tpu.config import get_config
+from featurenet_tpu.data.synthetic import CLASS_NAMES, generate_batch
+from featurenet_tpu.obs import quality as _quality
+from featurenet_tpu.obs import tsdb as _tsdb
+from featurenet_tpu.obs import windows as _windows
+from featurenet_tpu.obs.report import (
+    build_report_dir,
+    format_report,
+    load_events,
+)
+from featurenet_tpu.serve.recorder import (
+    FlightRecorder,
+    capture_dir,
+    pack_grid,
+    read_captures,
+    unpack_grid,
+)
+
+RES = 16  # smoke16 resolution — every real-model test runs at 16³
+NUM_CLASSES = len(CLASS_NAMES)
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    """A real trained smoke16 checkpoint for the CLI-level canary
+    tests (pin-quality and replay load through from_checkpoint)."""
+    from featurenet_tpu.train import Trainer
+
+    d = str(tmp_path_factory.mktemp("quality_ckpt") / "ckpt")
+    cfg = get_config(
+        "smoke16", total_steps=6, eval_every=10**9, checkpoint_every=6,
+        log_every=6, checkpoint_dir=d, data_workers=1,
+    )
+    Trainer(cfg).run()
+    return d
+
+
+# --- confidence statistics and drift math ------------------------------------
+
+def test_confidence_stats_top1_margin_entropy():
+    top1, margin, ent = _quality.confidence_stats([0.7, 0.2, 0.1])
+    assert top1 == pytest.approx(0.7)
+    assert margin == pytest.approx(0.5)
+    # -sum p ln p; zero-probability classes contribute nothing.
+    assert ent == pytest.approx(0.8018, abs=1e-3)
+    assert _quality.confidence_stats([]) == (0.0, 0.0, 0.0)
+    # A one-hot row: certain, maximal margin, zero entropy.
+    assert _quality.confidence_stats([0.0, 1.0, 0.0]) == (1.0, 1.0, 0.0)
+
+
+def test_drift_score_bounds_and_width_mismatch():
+    uniform = [0.25] * 4
+    assert _quality.drift_score([5, 5, 5, 5], uniform) == \
+        pytest.approx(0.0)
+    # Disjoint support: all mass where the baseline has none.
+    assert _quality.drift_score([10, 0, 0, 0], [0.0, 0.0, 0.5, 0.5]) \
+        == pytest.approx(1.0)
+    # Width mismatch: classes beyond either vector count as zero.
+    assert _quality.drift_score([10], [0.5, 0.5]) == pytest.approx(0.5)
+    assert _quality.drift_score([5, 5], [1.0]) == pytest.approx(0.5)
+    # No observations yet: score 0, not a crash or a false alarm.
+    assert _quality.drift_score([0, 0], [0.5, 0.5]) == 0.0
+
+
+def test_baseline_save_load_roundtrip_and_refusals(tmp_path):
+    path = str(tmp_path / "quality_baseline.json")
+    rec = _quality.save_baseline(
+        path, [3, 1, 0, 0], class_names=["a", "b", "c", "d"],
+        source={"n": 4},
+    )
+    assert rec["n"] == 4
+    assert rec["dist"] == [0.75, 0.25, 0.0, 0.0]
+    loaded = _quality.load_baseline(path)
+    assert loaded["dist"] == rec["dist"]
+    assert loaded["class_names"] == ["a", "b", "c", "d"]
+    # Refusals are config-time ValueErrors, never silent no-ops.
+    with pytest.raises(ValueError, match="at least one prediction"):
+        _quality.save_baseline(str(tmp_path / "x.json"), [0, 0])
+    with pytest.raises(ValueError, match="unreadable"):
+        _quality.load_baseline(str(tmp_path / "nope.json"))
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump({"dist": [0.2, 0.2]}, fh)  # sums to 0.4, not ~1
+    with pytest.raises(ValueError, match="sums to"):
+        _quality.load_baseline(bad)
+    with open(bad, "w") as fh:
+        json.dump({"dist": "not a vector"}, fh)
+    with pytest.raises(ValueError, match="no usable 'dist'"):
+        _quality.load_baseline(bad)
+
+
+def test_quality_rules_pair_and_drift_gating():
+    conf, drift = _quality.quality_rules()
+    assert (conf.metric, conf.op, conf.threshold) == \
+        ("confidence_p50", "<", 0.5)
+    assert (drift.metric, drift.op, drift.threshold) == \
+        ("quality_drift_score_p50", ">", 0.25)
+    assert conf.severity == drift.severity == "warning"
+    # No baseline pinned → no drift rule (an SLO on a score that can
+    # never compute would fire on absence).
+    (only_conf,) = _quality.quality_rules(with_drift=False)
+    assert only_conf.metric == "confidence_p50"
+    # Quality alerts page, they never fail a serving drain.
+    from featurenet_tpu.obs.alerts import is_serving_metric
+    assert not is_serving_metric(conf.metric)
+    assert not is_serving_metric(drift.metric)
+
+
+def test_quality_tracker_rolls_window_and_emits(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    tracker = _quality.QualityTracker(
+        3, baseline=[1.0, 0.0, 0.0], window=4, emit_every=4,
+    )
+    # Four on-baseline predictions: score 0.
+    for _ in range(4):
+        score = tracker.observe(0, 0.9, 0.8, 0.1)
+    assert score == pytest.approx(0.0)
+    # Four off-baseline ones displace them from the 4-wide ring: 1.0.
+    for _ in range(4):
+        score = tracker.observe(2, 0.9, 0.8, 0.1)
+    assert score == pytest.approx(1.0)
+    # Out-of-range labels are counted as seen but never in the ring.
+    tracker.observe(99, 0.5, 0.1, 0.2)
+    st = tracker.stats()
+    assert st == {"seen": 9, "window_n": 4,
+                  "drift_score": pytest.approx(1.0), "baseline": True}
+    obs.close_run()
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    qd = [e for e in events if e["ev"] == "quality_drift"]
+    assert len(qd) == 2  # every emit_every=4th observation
+    assert qd[-1]["score"] == pytest.approx(1.0)
+    assert qd[-1]["top_class"] == 2
+    # No baseline → observe returns None and emits no drift events.
+    bare = _quality.QualityTracker(3)
+    assert bare.observe(1, 0.9, 0.8, 0.1) is None
+    assert bare.stats()["baseline"] is False
+
+
+# --- flight recorder ---------------------------------------------------------
+
+def _grid(rng=None, fill=1.0):
+    if rng is None:
+        return np.full((RES, RES, RES, 1), fill, np.float32)
+    return (rng.random((RES, RES, RES, 1)) > 0.5).astype(np.float32)
+
+
+def test_pack_unpack_grid_lossless(rng):
+    g = _grid(rng)
+    rec = pack_grid(g)
+    assert rec["shape"] == [RES, RES, RES, 1]
+    np.testing.assert_array_equal(unpack_grid(rec), g)
+    # ~32× smaller than float32 on the wire (bit-packed + base64).
+    assert len(rec["bits"]) < g.nbytes / 20
+
+
+def test_recorder_capture_policy_is_tail_biased(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "cap"), sample=0.0,
+                         confidence_floor=0.35, slo_ms=100.0)
+    # Forced reasons, in priority order; sampling off → healthy drops.
+    assert rec.reason_for("t1", 0.9, 10.0, outcome="rejected") == \
+        "rejected"
+    assert rec.reason_for("t1", 0.9, 10.0, outcome="error") == "error"
+    assert rec.reason_for("t1", 0.1, 10.0) == "low_confidence"
+    assert rec.reason_for("t1", 0.9, 500.0) == "slo_breach"
+    assert rec.reason_for("t1", 0.9, 10.0) is None
+    assert not rec.maybe_capture(_grid(), "t1", confidence=0.9)
+    assert rec.stats()["skipped"] == 1
+    # sample=1.0 keeps every healthy request, deterministically.
+    keep = FlightRecorder(str(tmp_path / "cap2"), sample=1.0)
+    assert keep.reason_for("t1", 0.9, 10.0) == "sampled"
+    assert keep.maybe_capture(_grid(), "t1", label=3, confidence=0.9,
+                              total_ms=12.5)
+    keep.close()
+    (r,) = read_captures(keep.root)
+    assert (r["reason"], r["label"], r["confidence"], r["trace"]) == \
+        ("sampled", 3, 0.9, "t1")
+    with pytest.raises(ValueError, match="sample"):
+        FlightRecorder(str(tmp_path / "cap3"), sample=1.5)
+
+
+def test_recorder_rotates_prunes_and_reader_survives_tears(tmp_path):
+    root = str(tmp_path / "cap")
+    one_line = len(json.dumps(
+        {"t": 0.0, "trace": "t000", "reason": "low_confidence",
+         "voxels": pack_grid(_grid())}, separators=(",", ":"),
+    )) + 20
+    rec = FlightRecorder(root, confidence_floor=1.0,
+                         segment_bytes=one_line * 2,
+                         max_bytes=one_line * 5)
+    for i in range(10):
+        assert rec.maybe_capture(_grid(fill=float(i % 2)), f"t{i:03d}",
+                                 label=i, confidence=0.0)
+    rec.close()
+    segs = sorted(n for n in os.listdir(root)
+                  if n.startswith("capture."))
+    assert len(segs) >= 2  # rotated
+    total = sum(os.path.getsize(os.path.join(root, n)) for n in segs)
+    assert total <= one_line * 5 + one_line * 2  # pruned to ~budget
+    recs = read_captures(root)
+    assert len(recs) < 10  # oldest segments pruned
+    # Newest-first survivors, in capture order, payloads intact.
+    labels = [r["label"] for r in recs]
+    assert labels == sorted(labels) and labels[-1] == 9
+    np.testing.assert_array_equal(
+        unpack_grid(recs[-1]["voxels"]), _grid(fill=1.0))
+    # A torn tail + foreign garbage: skipped, never raised.
+    with open(os.path.join(root, segs[-1]), "ab") as fh:
+        fh.write(b"garbage\n")
+        fh.write(b'{"torn": ')
+    assert [r["label"] for r in read_captures(root)] == labels
+    # A respawned writer resumes the ring past the tear.
+    rec2 = FlightRecorder(root, confidence_floor=1.0,
+                          segment_bytes=one_line * 2,
+                          max_bytes=one_line * 5)
+    assert rec2.maybe_capture(_grid(), "t999", label=99, confidence=0.0)
+    rec2.close()
+    assert read_captures(root)[-1]["label"] == 99
+
+
+def test_recorder_goes_dark_on_disk_error_not_down(tmp_path):
+    blocker = str(tmp_path / "file")
+    with open(blocker, "w") as fh:
+        fh.write("not a directory")
+    rec = FlightRecorder(blocker, confidence_floor=1.0)
+    # First write hits the OSError → dark; later writes are counters.
+    assert not rec.maybe_capture(_grid(), "t1", confidence=0.0)
+    assert not rec.maybe_capture(_grid(), "t2", confidence=0.0)
+    st = rec.stats()
+    assert st["dark"] and st["dropped"] == 2 and st["captured"] == 0
+    assert read_captures(blocker) == []
+    rec.close()
+
+
+# --- acceptance: skewed mix fires the drift alert, recovery resolves it ------
+
+def test_quality_drift_alert_fires_and_resolves_e2e(tmp_path):
+    """The hysteresis pair on the quality plane: single-class traffic
+    against a uniform baseline pushes the rolling TV score over the
+    ceiling (ONE fire), the mix returning to baseline brings the window
+    median back under it (ONE resolve) — and the report renders both the
+    alert pair and the quality section."""
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    agg = _windows.WindowAggregator(
+        rules=list(_quality.quality_rules()), window=32, emit_every_s=0.0,
+    )
+    _windows.install(agg)
+    tracker = _quality.QualityTracker(
+        NUM_CLASSES, baseline=[1.0 / NUM_CLASSES] * NUM_CLASSES,
+        window=2 * NUM_CLASSES, emit_every=8,
+    )
+    # Skewed phase: every prediction lands on one class.
+    for _ in range(64):
+        tracker.observe(0, 0.9, 0.6, 0.3)
+    assert agg.active_alerts() == ["quality_drift_score_p50"]
+    # Recovery phase: a balanced round-robin refills the tracker ring
+    # with the baseline mix; the score decays and the alert resolves.
+    for i in range(2000):
+        tracker.observe(i % NUM_CLASSES, 0.9, 0.6, 0.3)
+        if not agg.active_alerts():
+            break
+    assert agg.active_alerts() == []
+    obs.close_run()
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    pair = [(e["state"], e["value"]) for e in events
+            if e["ev"] == "alert" and e["rule"] ==
+            "quality_drift_score_p50"]
+    assert [s for s, _ in pair] == ["fire", "resolve"]  # exactly one each
+    assert pair[0][1] > 0.25 >= pair[1][1]
+    # Healthy confidence never trips the collapse rule.
+    assert not any(e["ev"] == "alert" and e["rule"] == "confidence_p50"
+                   for e in events)
+    rep = build_report_dir(run_dir)
+    q = rep["quality"]
+    assert q["drift"]["snapshots"] >= 2
+    assert q["drift"]["max_score"] > 0.25
+    assert q["drift"]["last_score"] < 0.25
+    assert q["confidence"]["p50"] == pytest.approx(0.9)
+    text = format_report(rep)
+    assert "quality:" in text and "drift:" in text
+
+
+def test_confidence_collapse_alert_without_baseline(tmp_path):
+    obs.init_run(str(tmp_path / "run"), process_index=0)
+    agg = _windows.WindowAggregator(
+        rules=list(_quality.quality_rules(with_drift=False)),
+        window=16, emit_every_s=0.0,
+    )
+    _windows.install(agg)
+    tracker = _quality.QualityTracker(NUM_CLASSES)  # no baseline
+    for _ in range(16):
+        tracker.observe(1, 0.08, 0.01, 3.1)  # near-uniform softmax
+    assert agg.active_alerts() == ["confidence_p50"]
+    for _ in range(32):
+        tracker.observe(1, 0.95, 0.9, 0.1)
+    assert agg.active_alerts() == []
+    obs.close_run()
+
+
+# --- the serving path feeds both planes --------------------------------------
+
+def test_service_quality_and_capture_e2e(tmp_path, rng):
+    """The wiring acceptance: a real (random-init) service with the
+    tracker and the recorder attached — every answered request reaches
+    both, rejections reach the ring, and the report folds the capture
+    counts without reading the ring."""
+    import jax
+    import jax.numpy as jnp
+
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.runtime.registry import build_model
+    from featurenet_tpu.serve.service import InferenceService
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0)
+    cfg = get_config("smoke16", data_workers=1)
+    variables = build_model(cfg).init(
+        jax.random.key(0), jnp.zeros((1, RES, RES, RES, 1), jnp.float32),
+        train=False,
+    )
+    pred = Predictor(
+        variables["params"], variables["batch_stats"], cfg, batch=4
+    )
+    quality = _quality.QualityTracker(
+        NUM_CLASSES, baseline=[1.0 / NUM_CLASSES] * NUM_CLASSES,
+        window=32, emit_every=4,
+    )
+    # confidence_floor=1.0 forces every answered request into the ring —
+    # the test wants captured == served, not a sampling estimate.
+    recorder = FlightRecorder(capture_dir(run_dir), sample=0.0,
+                              confidence_floor=1.0)
+    service = InferenceService(
+        pred, buckets=(1, 4), max_wait_ms=5, queue_limit=64, rules=(),
+        quality=quality, recorder=recorder,
+    )
+    grids = generate_batch(rng, 12, RES)["voxels"]
+    futs = [service.submit_voxels(g) for g in grids]
+    for fut in futs:
+        fut.result(60)
+    st = service.drain()
+    assert st["quality"]["seen"] == 12
+    assert st["quality"]["drift_score"] is not None
+    assert st["capture"]["captured"] == 12
+    assert not st["capture"]["dark"]
+    obs.close_run()
+
+    recs = read_captures(recorder.root)
+    assert len(recs) == 12
+    assert all(r["reason"] == "low_confidence" for r in recs)
+    assert all(0 <= r["label"] < NUM_CLASSES and
+               0.0 <= r["confidence"] <= 1.0 for r in recs)
+    # Payloads are the served grids, losslessly (order-insensitive:
+    # batching may reorder across buckets).
+    want = sorted(float((g > 0.5).sum()) for g in grids)
+    got = sorted(float(unpack_grid(r["voxels"]).sum()) for r in recs)
+    assert got == want
+    rep = build_report_dir(run_dir)
+    assert rep["quality"]["captures"] == {
+        "count": 12, "by_reason": {"low_confidence": 12}}
+    assert rep["quality"]["drift"]["snapshots"] == 3
+    assert "captures: 12 (low_confidence×12)" in format_report(rep)
+
+
+def test_service_refuses_quality_on_non_classify():
+    from types import SimpleNamespace
+
+    from featurenet_tpu.serve.service import InferenceService
+
+    pred = SimpleNamespace(cfg=SimpleNamespace(task="segment"))
+    with pytest.raises(ValueError, match="classify"):
+        InferenceService(pred, buckets=(1,),
+                         quality=_quality.QualityTracker(2))
+
+
+# --- cli pin-quality ---------------------------------------------------------
+
+def test_cli_pin_quality_writes_baseline(ckpt_dir, tmp_path, capsys):
+    from featurenet_tpu.cli import main as cli_main
+
+    out = str(tmp_path / "quality_baseline.json")
+    assert cli_main([
+        "pin-quality", "--checkpoint-dir", ckpt_dir,
+        "--n", "16", "--batch", "8", "--out", out,
+    ]) is None
+    printed = json.loads(capsys.readouterr().out)["quality_baseline"]
+    assert printed["path"] == out and printed["n"] == 16
+    assert printed["top"][0]["p"] > 0
+    rec = _quality.load_baseline(out)  # validates shape + normalization
+    assert len(rec["dist"]) == NUM_CLASSES
+    assert sum(rec["dist"]) == pytest.approx(1.0, abs=0.01)
+    assert rec["class_names"] == list(CLASS_NAMES)
+    assert rec["source"]["checkpoint_dir"] == ckpt_dir
+
+
+# --- cli replay: the canary loop ---------------------------------------------
+
+def _record_ring(ckpt_dir, ring: str, grids, falsify: bool = False):
+    """Score grids with the pinned checkpoint and write them into a
+    capture ring the way a serving process would — optionally with the
+    recorded labels falsified (the deliberately-broken-candidate case:
+    a candidate that agrees with nothing)."""
+    from featurenet_tpu.infer import Predictor
+
+    pred = Predictor.from_checkpoint(ckpt_dir, batch=8)
+    labels, probs = pred.predict_voxels(grids)
+    rec = FlightRecorder(ring, sample=0.0, confidence_floor=1.1)
+    for i in range(len(grids)):
+        label = int(labels[i])
+        if falsify:
+            label = (label + 1) % NUM_CLASSES
+        rec.maybe_capture(
+            grids[i], f"t{i:04d}", label=label,
+            confidence=float(probs[i, labels[i]]), total_ms=5.0,
+        )
+    rec.close()
+    return [int(lb) for lb in labels]
+
+
+def test_cli_replay_agreement_gate_and_zero_compiles(
+    ckpt_dir, tmp_path, rng, capsys
+):
+    """Acceptance: replaying the ring against the bf16 candidate of the
+    same checkpoint clears the 0.967 agreement gate with ZERO
+    post-warmup compiles and a clean exit; the same ring with falsified
+    labels (a candidate that agrees with nothing) exits 2 and records
+    its verdict in the run log."""
+    from featurenet_tpu.cli import main as cli_main
+
+    grids = generate_batch(rng, 12, RES)["voxels"]
+    ring = str(tmp_path / "ring")
+    _record_ring(ckpt_dir, ring, grids)
+    assert cli_main([
+        "replay", ring, "--checkpoint-dir", ckpt_dir,
+        "--precision", "bf16", "--batch", "8",
+    ]) is None
+    verdict = json.loads(capsys.readouterr().out)["replay"]
+    assert verdict["n"] == 12
+    assert verdict["agreement"] >= 0.967
+    assert verdict["ok"] is True
+    assert verdict["post_warmup_compiles"] == 0
+    assert verdict["candidate"]["precision"] == "bf16"
+    assert verdict["confidence_delta"]["max_abs"] < 0.05
+
+    # The broken candidate: recorded labels disagree everywhere.
+    bad = str(tmp_path / "bad_ring")
+    _record_ring(ckpt_dir, bad, grids, falsify=True)
+    run_dir = str(tmp_path / "run")
+    with pytest.raises(SystemExit) as ei:
+        cli_main([
+            "replay", bad, "--checkpoint-dir", ckpt_dir,
+            "--batch", "8", "--run-dir", run_dir,
+        ])
+    assert ei.value.code == 2
+    verdict = json.loads(capsys.readouterr().out)["replay"]
+    assert verdict["agreement"] == 0.0 and verdict["ok"] is False
+    assert verdict["flips"]  # every disagreement is attributed
+    assert sum(verdict["flips"].values()) == 12
+    # The verdict is telemetry too: event in the run log, folded by the
+    # report's quality section.
+    events, _ = load_events(run_dir)
+    (rv,) = [e for e in events if e["ev"] == "replay_verdict"]
+    assert rv["agreement"] == 0.0 and rv["ok"] is False
+    rep = build_report_dir(run_dir)
+    assert rep["quality"]["replay"] == {
+        "runs": 1, "agreement": 0.0, "n": 12, "ok": False}
+    assert "BELOW GATE" in format_report(rep)
+
+
+def test_cli_replay_refusals(ckpt_dir, tmp_path):
+    from featurenet_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="no re-scorable capture"):
+        cli_main(["replay", str(tmp_path / "empty"),
+                  "--checkpoint-dir", ckpt_dir])
+    with pytest.raises(SystemExit, match="min-agreement"):
+        cli_main(["replay", str(tmp_path / "empty"),
+                  "--checkpoint-dir", ckpt_dir, "--min-agreement", "2"])
+
+
+# --- dash: quality panel + friendly empty state ------------------------------
+
+def test_dash_empty_state_is_friendly(tmp_path):
+    from featurenet_tpu.obs.dash import render_frame
+
+    # A typo'd / never-created run_dir.
+    missing = str(tmp_path / "nowhere")
+    frame = render_frame(missing, now=T0)
+    assert "0 target(s)" in frame
+    assert "no such directory" in frame and "fleet scraper" in frame
+    # A store directory that exists but was never written.
+    empty = str(tmp_path / "run")
+    os.makedirs(_tsdb.store_dir(empty))
+    frame = render_frame(empty, now=T0)
+    assert "0 target(s)" in frame and "no samples yet" in frame
+
+
+def test_dash_quality_panel_only_when_plane_is_on(tmp_path):
+    from featurenet_tpu.obs.dash import render_frame
+
+    run_dir = str(tmp_path / "run")
+    store = _tsdb.TimeSeriesStore(_tsdb.store_dir(run_dir))
+    for i in range(10):
+        t = T0 - 10 + i
+        store.append("requests_total", i * 5.0,
+                     {"outcome": "served", "replica": "0"}, t=t)
+        store.append("serving_ms", 20.0, {"q": "0.99", "replica": "0"},
+                     t=t)
+    store.close()
+    frame = render_frame(run_dir, now=T0)
+    assert "confidence p50" not in frame  # plane off: no quality panel
+    store = _tsdb.TimeSeriesStore(_tsdb.store_dir(run_dir))
+    for i in range(10):
+        t = T0 - 10 + i
+        store.append("confidence", 0.9 - i * 0.05,
+                     {"q": "0.5", "replica": "0"}, t=t)
+        store.append("quality_drift_score", 0.1 * i,
+                     {"q": "0.5", "replica": "0"}, t=t)
+    store.close()
+    frame = render_frame(run_dir, now=T0)
+    lines = frame.splitlines()
+    (head,) = [ln for ln in lines
+               if ln.startswith("quality") and "confidence p50" in ln]
+    assert "drift p50" in head
+    (row,) = [ln for ln in lines[lines.index(head) + 1:]
+              if ln.startswith("0 ")]
+    assert "0.450" in row and "0.900" in row  # last conf p50, last drift
+
+
+# --- registries + bench gate wiring ------------------------------------------
+
+def test_quality_plane_registry_wiring():
+    """The closed registries every satellite leans on: window metrics,
+    exporter families, event schema, lint kinds, bench gate keys."""
+    from featurenet_tpu.obs import gates as _gates
+    from featurenet_tpu.obs.alerts import WINDOW_METRICS
+    from featurenet_tpu.obs.bench_history import _COLUMNS
+    from featurenet_tpu.obs.report import (
+        KNOWN_EVENT_KINDS,
+        REQUIRED_EVENT_FIELDS,
+    )
+    from featurenet_tpu.serve.metrics import METRIC_NAMES
+
+    windows_new = {"confidence", "confidence_margin",
+                   "prediction_entropy", "quality_drift_score"}
+    assert windows_new <= set(WINDOW_METRICS)
+    assert windows_new <= METRIC_NAMES
+    assert {f"{m}_count" for m in windows_new} <= METRIC_NAMES
+    assert {"quality_drift", "capture", "replay_verdict"} <= \
+        KNOWN_EVENT_KINDS
+    assert REQUIRED_EVENT_FIELDS["quality_drift"] == ("score", "n")
+    assert REQUIRED_EVENT_FIELDS["capture"] == ("trace", "reason")
+    assert REQUIRED_EVENT_FIELDS["replay_verdict"] == \
+        ("agreement", "n", "ok")
+    assert _gates.DIRECTIONS["quality_overhead_pct"] == "max"
+    assert "quality_overhead_pct" in _gates.BENCH_GATE_KEYS
+    assert "quality_overhead_pct" in _gates.NOISY_KEY_ABS_SLACK
+    assert any(key == "quality_overhead_pct" for key, _, _ in _COLUMNS)

@@ -181,11 +181,67 @@ def test_parse_exposition_labels_escapes_and_garbage():
     assert len(out) == 3  # the malformed lines vanished, not raised
 
 
+def test_parse_exposition_exotic_but_legal_text():
+    """Prometheus text a foreign exporter could legally emit: exponent
+    floats, millisecond timestamps, untyped families, non-finite
+    values, and the escaped-backslash-before-n trap."""
+    import math
+
+    text = "\n".join([
+        'featurenet_z{msg="a\\\\nb"} 1e3 1700000000123',
+        "featurenet_naked NaN",         # no HELP/TYPE, non-finite value
+        "featurenet_up +Inf",
+        "featurenet_down -Inf",
+        'featurenet_bad{unclosed="x} 1',   # brace inside the quotes
+        'featurenet_noval{a="b"}',         # sample with no value
+    ])
+    out = parse_exposition(text)
+    # The escaped backslash survives as a backslash followed by a
+    # LITERAL n — not a newline (single-pass unescape, not sequential
+    # replaces).
+    assert ("featurenet_z", {"msg": "a\\nb"}, 1000.0) in out
+    by_name = {n: v for n, _, v in out}
+    assert math.isnan(by_name["featurenet_naked"])
+    assert by_name["featurenet_up"] == float("inf")
+    assert by_name["featurenet_down"] == float("-inf")
+    assert len(out) == 4  # both malformed lines skipped
+
+
+def test_label_escaping_roundtrips_through_parser():
+    """Exporter → scraper round-trip for every escape the exposition
+    format defines, including values where an escaped backslash
+    precedes a quote or an ``n``."""
+    from featurenet_tpu.serve.metrics import _escape_label
+
+    for raw in ("plain", 'quo"te', "new\nline", "back\\slash",
+                "a\\nb", "trail\\", '\\"mix\n\\'):
+        line = f'featurenet_x{{v="{_escape_label(raw)}"}} 1'
+        ((_, labels, value),) = parse_exposition(line)
+        assert labels["v"] == raw, raw
+        assert value == 1.0
+
+
+def test_exporter_formats_nonfinite_values():
+    """Both exporters lean on one value formatter; NaN/±Inf must render
+    as the exposition spellings, never as Python's ``nan``/``inf`` (a
+    strict scraper rejects those)."""
+    from featurenet_tpu.serve.metrics import _fmt
+
+    assert _fmt(float("nan")) == "NaN"
+    assert _fmt(float("inf")) == "+Inf"
+    assert _fmt(float("-inf")) == "-Inf"
+    assert _fmt(True) == "1"
+    assert _fmt(3) == "3"
+    # And the scraper's parser takes every spelling straight back.
+    for s in ("NaN", "+Inf", "-Inf", "1"):
+        assert parse_exposition(f"featurenet_x {s}")
+
+
 # --- exposition compliance (satellite: both exporters) -----------------------
 
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?P<labels>\{[^{}]*\})? (?P<value>-?[0-9.eE+-]+|NaN)$"
+    r"(?P<labels>\{[^{}]*\})? (?P<value>-?[0-9.eE+-]+|NaN|[+-]?Inf)$"
 )
 
 
@@ -642,6 +698,35 @@ def test_trend_gate_passes_within_slack(tmp_path):
     _write_round(d, 2, {"value": 950.0, "scrape_overhead_pct": 6.0})
     res = trend_gate(load_rounds(d))
     assert res["ok"], res
+
+
+def test_trend_gate_skips_latest_skipped_and_unparseable_rounds(
+    tmp_path, capsys
+):
+    """A TPU outage as the LATEST round (structured skip or the bare
+    driver wrapper with ``parsed: null``) must not fail --gate: the gate
+    judges the last two PARSEABLE rounds, and the table still renders
+    the outage rows with their reasons."""
+    from featurenet_tpu.cli import main as cli_main
+    from featurenet_tpu.obs.bench_history import load_rounds, trend_gate
+
+    d = str(tmp_path)
+    _write_round(d, 1, {"value": 1000.0})
+    _write_round(d, 2, {"value": 990.0})
+    _write_round(d, 3, {"skipped": True, "reason": "no accelerator"})
+    _write_round(d, 4, {"n": 4, "cmd": "python bench.py", "rc": 1,
+                        "tail": "boom", "parsed": None})
+    rows = load_rounds(d)
+    assert [r["status"] for r in rows] == \
+        ["ok", "ok", "skipped", "unparseable"]
+    res = trend_gate(rows)
+    assert res["ok"]
+    assert (res["baseline_round"], res["candidate_round"]) == \
+        ("r01", "r02")
+    assert cli_main(["bench-history", d, "--gate"]) is None  # exit 0
+    out = capsys.readouterr().out
+    assert "no accelerator" in out       # the outage keeps its row
+    assert "unparseable" in out
 
 
 # --- report: the store-only fleet timeline -----------------------------------
