@@ -390,6 +390,12 @@ def _measure_round(platform: str) -> dict:
     # The row now measures the POOLED data plane (PR 15): every hop is
     # keep-alive, and fleet_conn_reuse_ratio is pinned (min) so the
     # plane can never silently rot back to connect-per-request.
+    # The acting control loop + rollout plane ride the same fleet:
+    # fleet_scale_actions (autoscaler moves under handled load — pinned
+    # ~0, the flap-damping evidence), rollout_swap_ms (live hot-swap of
+    # one replica back onto its own checkpoint), and rollout_agreement
+    # (the swapped replica's capture ring replayed against that
+    # checkpoint — pinned min ≈ 1.0).
     fleet_row: dict = {}
     try:
         from featurenet_tpu.fleet.loadgen import bench_fleet

@@ -358,6 +358,323 @@ def _cfg_from_checkpoint(saved, args):
     )
 
 
+def _score_capture_ring(pred, capture_dir: str, recs=None):
+    """Re-score one flight-recorder ring through ``pred``'s AOT serving
+    path — the shared canary core of ``cli replay`` and ``cli fleet
+    rollout`` (one implementation, so the agreement arithmetic and the
+    zero-compile evidence can never drift between the CI gate and the
+    live-rollout gate). Returns ``(recs, labels, probs, score_ms,
+    post_warmup_compiles)``; ``recs`` is the label-carrying record list
+    (``[]`` when the ring is missing/empty — only answered requests
+    carry a recorded prediction to agree with)."""
+    import numpy as np
+
+    from featurenet_tpu.obs import events as _events
+    from featurenet_tpu.serve.recorder import read_captures, unpack_grid
+
+    if recs is None:
+        recs = [r for r in read_captures(capture_dir)
+                if r.get("label") is not None]
+    if not recs:
+        return [], None, None, 0.0, 0
+    grids = np.stack([unpack_grid(r["voxels"]) for r in recs])
+    warm = _events.kind_counts().get("program_compile", 0)
+    t0 = time.perf_counter()
+    labels, probs = pred.predict_voxels(grids)
+    score_ms = (time.perf_counter() - t0) * 1e3
+    compiles = _events.kind_counts().get("program_compile", 0) - warm
+    return recs, labels, probs, score_ms, compiles
+
+
+# The rollout orchestrator's event stream index: far above any replica's
+# slot+1 stream so `cli fleet rollout` can append rollout_* events into
+# a LIVE fleet's run dir without ever colliding with a replica stream.
+_ROLLOUT_STREAM = 1000
+
+
+def _fleet_router_address(run_dir: str):
+    """The live fleet's router ``(host, port)``, read from the LAST
+    ``fleet_start`` event in the run's stream-0 log (the router owns
+    stream 0; an ephemeral ``--port 0`` is only ever printed/emitted, so
+    the event stream is the one durable place to find it). ``None`` when
+    the run dir has no fleet_start — not a fleet run dir."""
+    import os
+
+    from featurenet_tpu.obs.events import events_filename
+
+    addr = None
+    try:
+        with open(os.path.join(run_dir, events_filename(0)),
+                  encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line of a live log
+                if e.get("ev") == "fleet_start":
+                    addr = (e.get("host"), e.get("port"))
+    except OSError:
+        return None
+    if not addr or not addr[0] or not addr[1]:
+        return None
+    return addr[0], int(addr[1])
+
+
+def _cmd_fleet_rollout(args) -> None:
+    """``cli fleet rollout <checkpoint_dir>``: zero-downtime rolling
+    weight rollout across a LIVE fleet, one replica at a time —
+    replay-canary against that replica's own capture ring, hot-swap via
+    ``POST /admin/reload`` (the replica cordons itself and drains
+    through the router's spillover path while the new generation is
+    restored), verify the version tag, move on. Any canary failure,
+    swap refusal, or replica death mid-rollout rolls every
+    already-swapped replica back to its old checkpoint and exits 2."""
+    import http.client
+    import os
+
+    from featurenet_tpu import obs
+    from featurenet_tpu.config import get_config
+    from featurenet_tpu.fleet.pool import ConnectionPool
+    from featurenet_tpu.infer import Predictor
+    from featurenet_tpu.train.checkpoint import load_run_config
+
+    candidate = args.rollout_checkpoint_dir
+    if not (0.0 <= args.min_agreement <= 1.0):
+        raise SystemExit(
+            f"fleet rollout: --min-agreement must be in [0, 1], got "
+            f"{args.min_agreement}"
+        )
+    run_dir = getattr(args, "run_dir", None)
+    if not run_dir:
+        raise SystemExit(
+            "fleet rollout: --run-dir is required — it names the LIVE "
+            "fleet (router address from its event stream, capture rings "
+            "under <run-dir>/capture, rollout events into the same run)"
+        )
+    addr = _fleet_router_address(run_dir)
+    if addr is None:
+        raise SystemExit(
+            f"fleet rollout: no fleet_start event under {run_dir!r} — "
+            "point --run-dir at the run directory of a live `cli fleet`"
+        )
+    host, router_port = addr
+    pool = ConnectionPool(timeout_s=args.swap_timeout_s)
+
+    def _get_json(port: int, path: str,
+                  timeout_s: float = 10.0) -> tuple:
+        status, raw = pool.get(host, port, path, timeout_s)
+        try:
+            return status, json.loads(raw.decode("utf-8"))
+        except ValueError:
+            return status, {}
+
+    try:
+        status, health = _get_json(router_port, "/healthz")
+    except (OSError, http.client.HTTPException) as e:
+        raise SystemExit(
+            f"fleet rollout: router at {host}:{router_port} is "
+            f"unreachable ({e}) — the fleet must be live to roll"
+        )
+    ports = {int(s): int(p)
+             for s, p in (health.get("ports") or {}).items()}
+    if status != 200 or not ports:
+        raise SystemExit(
+            f"fleet rollout: fleet at {host}:{router_port} is not ready "
+            f"(status {status}, ports {ports}) — nothing to roll"
+        )
+    # The per-replica OLD identity, straight off each replica's own
+    # /healthz: the version tag proves the mixed-version window later,
+    # and checkpoint_dir is what a rollback re-submits.
+    roster: dict = {}
+    for slot, port in sorted(ports.items()):
+        try:
+            st, h = _get_json(port, "/healthz")
+        except (OSError, http.client.HTTPException):
+            continue
+        if st != 200 or not h.get("ready"):
+            continue
+        roster[slot] = {
+            "port": port,
+            "old_version": h.get("model_version", "unversioned"),
+            "old_checkpoint_dir": h.get("checkpoint_dir"),
+        }
+    if not roster:
+        raise SystemExit(
+            "fleet rollout: no ready replicas answered /healthz — "
+            "refusing to roll a degraded fleet"
+        )
+    saved = load_run_config(candidate)
+    cfg = saved if saved is not None else get_config(
+        args.config or "pod64"
+    )
+    obs.init_run(run_dir, extra={"cmd": "fleet-rollout"},
+                 process_index=_ROLLOUT_STREAM)
+    exit_code = 0
+    out: dict = {}
+    try:
+        # Construction is the canary's warmup: ONE scoring program
+        # builds here, in THIS process — the replicas' own AOT ladders
+        # are untouched, which is what "zero post-warmup compiles on
+        # the swapped path" means.
+        pred = Predictor.from_checkpoint(
+            candidate, cfg, batch=args.batch, precision=args.precision
+        )
+        if pred.cfg.task != "classify":
+            raise SystemExit(
+                "fleet rollout: capture rings hold classify traffic — "
+                f"the candidate is task={pred.cfg.task!r}"
+            )
+        target_version = pred.model_version
+        obs.emit("rollout_start", checkpoint_dir=str(candidate),
+                 replicas=sorted(roster), to_version=target_version)
+        swapped: list = []
+        steps: list = []
+        failure = None
+
+        def _rollback(reason: str) -> tuple:
+            rolled, failed = [], []
+            for slot in reversed(swapped):
+                info = roster[slot]
+                old = info["old_checkpoint_dir"]
+                if not old:
+                    failed.append(slot)
+                    continue
+                try:
+                    st, raw, _ra = pool.post(
+                        host, info["port"], "/admin/reload",
+                        json.dumps({"checkpoint_dir": old}).encode(),
+                        {"Content-Type": "application/json"},
+                        args.swap_timeout_s,
+                    )
+                    (rolled if st == 200 else failed).append(slot)
+                except (OSError, http.client.HTTPException):
+                    failed.append(slot)
+            obs.emit("rollout_rollback", reason=reason,
+                     rolled_back=rolled, failed=failed)
+            return rolled, failed
+
+        for slot in sorted(roster):
+            info = roster[slot]
+            ring = os.path.join(run_dir, "capture", f"replica{slot}")
+            recs, labels, _probs, _score_ms, compiles = \
+                _score_capture_ring(pred, ring)
+            agreement = None
+            if recs:
+                agree = sum(
+                    1 for i, r in enumerate(recs)
+                    if int(r["label"]) == int(labels[i])
+                )
+                agreement = agree / len(recs)
+                obs.emit("replay_verdict",
+                         agreement=round(agreement, 6), n=len(recs),
+                         ok=agreement >= args.min_agreement,
+                         min_agreement=args.min_agreement,
+                         flips=len(recs) - agree,
+                         post_warmup_compiles=compiles, replica=slot)
+                if agreement < args.min_agreement:
+                    obs.emit("rollout_step", replica=slot, ok=False,
+                             agreement=round(agreement, 6),
+                             reason="canary_failed")
+                    failure = (
+                        f"canary_failed(replica={slot},"
+                        f"agreement={agreement:.4f})"
+                    )
+                    break
+            try:
+                st, raw, _ra = pool.post(
+                    host, info["port"], "/admin/reload",
+                    json.dumps({"checkpoint_dir": candidate}).encode(),
+                    {"Content-Type": "application/json"},
+                    args.swap_timeout_s,
+                )
+            except (OSError, http.client.HTTPException) as e:
+                # The replica died (or vanished) mid-swap — the manager
+                # will respawn it on the OLD argv; our job is to roll
+                # the already-swapped peers back to match it.
+                obs.emit("rollout_step", replica=slot, ok=False,
+                         reason=f"replica_lost: {e}")
+                failure = f"replica_lost(replica={slot})"
+                break
+            try:
+                doc = json.loads(raw.decode("utf-8"))
+            except ValueError:
+                doc = {}
+            if st != 200:
+                kind = doc.get("kind") or st
+                obs.emit("rollout_step", replica=slot, ok=False,
+                         reason=f"swap_refused:{kind}")
+                failure = f"swap_refused(replica={slot},kind={kind})"
+                break
+            swapped.append(slot)
+            step = {
+                "replica": slot, "canary_n": len(recs),
+                "agreement": (None if agreement is None
+                              else round(agreement, 6)),
+                "swap_ms": doc.get("swap_ms"),
+                "model_version": doc.get("model_version"),
+            }
+            steps.append(step)
+            obs.emit("rollout_step", replica=slot, ok=True, **{
+                k: v for k, v in step.items() if k != "replica"
+            })
+        if failure is not None:
+            rolled, failed_rb = _rollback(failure)
+            converged = _wait_one_version(
+                pool, host, router_port, args.converge_timeout_s
+            )
+            obs.emit("rollout_done", ok=False, swapped=[],
+                     reason=failure, rolled_back=rolled)
+            out = {"ok": False, "reason": failure,
+                   "rolled_back": rolled, "rollback_failed": failed_rb,
+                   "converged": converged, "steps": steps}
+            exit_code = 2
+        else:
+            converged = _wait_one_version(
+                pool, host, router_port, args.converge_timeout_s,
+                expect=target_version,
+            )
+            obs.emit("rollout_done", ok=True, swapped=swapped,
+                     version=target_version, converged=converged)
+            out = {"ok": True, "version": target_version,
+                   "swapped": swapped, "converged": converged,
+                   "steps": steps}
+        print(json.dumps({"fleet_rollout": {
+            "checkpoint_dir": candidate, "run_dir": run_dir,
+            "min_agreement": args.min_agreement, **out,
+        }}))
+    finally:
+        pool.close()
+        obs.close_run()
+    if exit_code:
+        raise SystemExit(exit_code)
+
+
+def _wait_one_version(pool, host: str, router_port: int,
+                      timeout_s: float, expect=None) -> bool:
+    """Poll the router's roster until every replica with a known version
+    tag reports the SAME one (and ``expect``, when given) — the
+    "re-converged to one version" verdict. Bounded; False on timeout
+    (informational: the exit code rides the rollout verdict, not this)."""
+    import http.client
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            status, raw = pool.get(host, router_port, "/healthz", 10.0)
+            doc = json.loads(raw.decode("utf-8"))
+        except (OSError, http.client.HTTPException, ValueError):
+            time.sleep(0.5)
+            continue
+        versions = doc.get("versions") or {}
+        vals = set(versions.values())
+        healthy = doc.get("healthy", 0)
+        if (versions and len(vals) == 1 and healthy
+                and (expect is None or vals == {expect})):
+            return True
+        time.sleep(0.5)
+    return False
+
+
 def main(argv=None) -> None:
     # allow_abbrev=False everywhere: the supervisor re-execs a rewritten argv
     # with supervision flags stripped by exact match — a prefix abbreviation
@@ -826,7 +1143,11 @@ def main(argv=None) -> None:
                                 "replica loss, priority-lane shedding, "
                                 "Retry-After backoff, advisory "
                                 "fleet_scale verdicts")
-    p_flt.add_argument("--checkpoint-dir", required=True)
+    p_flt.add_argument("--checkpoint-dir", default=None,
+                       help="the checkpoint every replica serves "
+                            "(required to launch a fleet; the `rollout` "
+                            "subcommand instead names its candidate "
+                            "positionally)")
     p_flt.add_argument("--replicas", type=int, default=2,
                        help="serving replicas to run (default 2); each "
                             "is a supervised `cli serve --port 0` child "
@@ -914,6 +1235,87 @@ def main(argv=None) -> None:
                        dest="capture_sample",
                        help="per-replica capture rate (see `serve "
                             "--capture-sample`)")
+    p_flt.add_argument("--autoscale", action="store_true",
+                       help="ACT on the scale verdicts instead of only "
+                            "advising: a manager-owned control thread "
+                            "adds a replica on a sustained add verdict "
+                            "and drains+parks one on a sustained shed "
+                            "verdict, with hysteresis and a post-action "
+                            "cooldown so a flapping verdict never "
+                            "thrashes the roster")
+    p_flt.add_argument("--min-replicas", type=int, default=1,
+                       dest="min_replicas",
+                       help="autoscale floor: shed verdicts never take "
+                            "the roster below this (default 1)")
+    p_flt.add_argument("--max-replicas", type=int, default=None,
+                       dest="max_replicas",
+                       help="autoscale ceiling: add verdicts never take "
+                            "the roster above this (default: "
+                            "--replicas + 2)")
+    p_flt.add_argument("--scale-hysteresis", type=int, default=3,
+                       dest="scale_hysteresis",
+                       help="consecutive identical actionable verdicts "
+                            "required before the autoscaler moves the "
+                            "roster (default 3)")
+    p_flt.add_argument("--scale-cooldown-s", type=float, default=30.0,
+                       dest="scale_cooldown_s",
+                       help="minimum seconds since the LAST ACTION "
+                            "before the autoscaler acts again — flap "
+                            "damping measured from actions, not verdict "
+                            "edges (default 30)")
+    flt_sub = p_flt.add_subparsers(dest="fleet_cmd", metavar="{rollout}")
+    p_rol = flt_sub.add_parser(
+        "rollout", allow_abbrev=False,
+        help="zero-downtime rolling weight rollout: swap a LIVE fleet "
+             "(launched with `cli fleet --capture --run-dir D`) onto a "
+             "candidate checkpoint one replica at a time — each replica "
+             "is replay-canaried against its own capture ring, drained "
+             "through the router's spillover path, hot-swapped via "
+             "POST /admin/reload, and verified on /healthz; a canary "
+             "failure, swap refusal, or replica death mid-rollout rolls "
+             "every already-swapped replica back to its old checkpoint "
+             "and EXITS 2")
+    p_rol.add_argument("rollout_checkpoint_dir",
+                       metavar="checkpoint_dir",
+                       help="the CANDIDATE checkpoint directory to roll "
+                            "the fleet onto")
+    # SUPPRESS: the fleet-level --run-dir default (None) must survive
+    # when the operator puts the flag before the subcommand token —
+    # a subparser default would clobber the already-parsed value.
+    p_rol.add_argument("--run-dir", dest="run_dir",
+                       default=argparse.SUPPRESS,
+                       help="the LIVE fleet's observability directory: "
+                            "the router address is read from its event "
+                            "stream, capture rings from "
+                            "<run-dir>/capture/replica<slot>, and the "
+                            "rollout_* events land in the same run")
+    p_rol.add_argument("--min-agreement", type=float, default=0.967,
+                       dest="min_agreement",
+                       help="per-replica replay-canary gate: the "
+                            "candidate must match at least this "
+                            "fraction of the replica's captured "
+                            "predictions or the rollout rolls back "
+                            "(default 0.967, the paper's accuracy bar)")
+    p_rol.add_argument("--batch", type=int, default=32,
+                       help="canary scoring batch size (default 32)")
+    p_rol.add_argument("--config", default=None,
+                       help="only needed for legacy candidate "
+                            "checkpoints without a persisted "
+                            "config.json")
+    p_rol.add_argument("--precision", choices=["fp32", "bf16", "int8"],
+                       default=None,
+                       help="candidate scoring precision for the canary "
+                            "(default: the candidate config's "
+                            "serve_precision)")
+    p_rol.add_argument("--swap-timeout-s", type=float, default=120.0,
+                       dest="swap_timeout_s",
+                       help="per-replica /admin/reload deadline "
+                            "(default 120)")
+    p_rol.add_argument("--converge-timeout-s", type=float, default=120.0,
+                       dest="converge_timeout_s",
+                       help="bounded wait for the roster's /healthz "
+                            "version tags to converge after the last "
+                            "swap or after a rollback (default 120)")
     p_rpq = sub.add_parser(
         "pin-quality", allow_abbrev=False,
         help="pin a predicted-class-mix baseline "
@@ -1742,14 +2144,11 @@ def main(argv=None) -> None:
         import shutil
         import tempfile
 
-        import numpy as np
-
         from featurenet_tpu import obs
         from featurenet_tpu.config import get_config
         from featurenet_tpu.data.synthetic import CLASS_NAMES
         from featurenet_tpu.infer import Predictor
-        from featurenet_tpu.obs import events as _events
-        from featurenet_tpu.serve.recorder import read_captures, unpack_grid
+        from featurenet_tpu.serve.recorder import read_captures
         from featurenet_tpu.train.checkpoint import load_run_config
 
         if not (0.0 <= args.min_agreement <= 1.0):
@@ -1774,7 +2173,6 @@ def main(argv=None) -> None:
             else get_config(args.config or "pod64"),
             args,
         )
-        grids = np.stack([unpack_grid(r["voxels"]) for r in recs])
         # The replay sink: the verdict event needs a live stream and the
         # zero-compile evidence needs the sink's program_compile counter
         # — a throwaway run_dir serves both when the operator gave none.
@@ -1795,12 +2193,8 @@ def main(argv=None) -> None:
                     "replay: capture rings hold classify traffic — the "
                     f"candidate is task={pred.cfg.task!r}"
                 )
-            warm = _events.kind_counts().get("program_compile", 0)
-            t0 = time.perf_counter()
-            labels, probs = pred.predict_voxels(grids)
-            score_ms = (time.perf_counter() - t0) * 1e3
-            compiles = (
-                _events.kind_counts().get("program_compile", 0) - warm
+            recs, labels, probs, score_ms, compiles = _score_capture_ring(
+                pred, args.capture_dir, recs=recs
             )
 
             def _cls(c: int) -> str:
@@ -1989,7 +2383,11 @@ def main(argv=None) -> None:
 
             def _beat():
                 while not hb_stop.is_set():
-                    if service.ready():
+                    # A mid-swap replica is cordoned (not ready) but
+                    # alive and working — its liveness beat must not
+                    # stop, or the manager would kill it as stalled
+                    # halfway through a weight reload.
+                    if service.ready() or service.reloading():
                         touch_heartbeat(args.heartbeat_file)
                     hb_stop.wait(1.0)
 
@@ -2037,13 +2435,18 @@ def main(argv=None) -> None:
             raise SystemExit(st["exit_code"])
         return
 
+    if args.cmd == "fleet" and getattr(args, "fleet_cmd", None) == \
+            "rollout":
+        _cmd_fleet_rollout(args)
+        return
+
     if args.cmd == "fleet":
         import signal
         import threading
 
         from featurenet_tpu import faults, obs
         from featurenet_tpu.fleet.loadgen import replica_argv
-        from featurenet_tpu.fleet.replica import ReplicaManager
+        from featurenet_tpu.fleet.replica import Autoscaler, ReplicaManager
         from featurenet_tpu.fleet.router import FleetRouter
         from featurenet_tpu.fleet.scraper import (
             ROUTER_TARGET,
@@ -2052,10 +2455,28 @@ def main(argv=None) -> None:
         from featurenet_tpu.obs import alerts as _alerts
         from featurenet_tpu.obs import tsdb as _tsdb
 
+        if not args.checkpoint_dir:
+            raise SystemExit(
+                "fleet: --checkpoint-dir is required to launch a fleet"
+            )
         if args.replicas < 1:
             raise SystemExit(
                 f"fleet: --replicas must be >= 1, got {args.replicas}"
             )
+        max_replicas = (args.max_replicas if args.max_replicas is not None
+                        else args.replicas + 2)
+        if args.autoscale:
+            if args.min_replicas < 1:
+                raise SystemExit(
+                    f"fleet: --min-replicas must be >= 1, got "
+                    f"{args.min_replicas}"
+                )
+            if not (args.min_replicas <= args.replicas <= max_replicas):
+                raise SystemExit(
+                    f"fleet: --replicas {args.replicas} must sit inside "
+                    f"[--min-replicas {args.min_replicas}, "
+                    f"--max-replicas {max_replicas}]"
+                )
         if not getattr(args, "run_dir", None):
             raise SystemExit(
                 "fleet: --run-dir is required — the roster "
@@ -2119,6 +2540,20 @@ def main(argv=None) -> None:
             store=store, slos=slos,
         )
         manager.start()
+        # The ACTING half of the control loop (opt-in): a manager-owned
+        # thread turns sustained burn verdicts into add_one/shed_one,
+        # damped by hysteresis + a cooldown measured from the last
+        # ACTION. Without --autoscale the verdicts stay advisory
+        # (fleet_scale events), exactly as before.
+        autoscaler = None
+        if args.autoscale:
+            autoscaler = Autoscaler(
+                manager, router.scale_state,
+                min_replicas=args.min_replicas,
+                max_replicas=max_replicas,
+                hysteresis=args.scale_hysteresis,
+                cooldown_s=args.scale_cooldown_s,
+            )
         srv = router.make_server(host=args.host, port=args.port)
         scraper = MetricsScraper(
             store, manager.pool,
@@ -2129,6 +2564,8 @@ def main(argv=None) -> None:
             },
         )
         scraper.start()
+        if autoscaler is not None:
+            autoscaler.start()
         obs.emit("fleet_start", replicas=args.replicas,
                  host=srv.server_address[0], port=srv.server_address[1])
         threading.Thread(target=srv.serve_forever, name="fleet-http",
@@ -2137,6 +2574,8 @@ def main(argv=None) -> None:
             "host": srv.server_address[0], "port": srv.server_address[1],
             "replicas": args.replicas, "buckets": args.buckets,
             "batch_shed_depth": args.batch_shed_depth,
+            "autoscale": (None if autoscaler is None
+                          else autoscaler.stats()),
             "run_dir": args.run_dir,
         }}), flush=True)
         stop = threading.Event()
@@ -2153,14 +2592,20 @@ def main(argv=None) -> None:
         finally:
             for sig, h in prev_handlers.items():
                 signal.signal(sig, h)
-        # One final synchronous scrape before the replicas go away so
+        # Stop ACTING before anything drains (a scale action against a
+        # half-torn-down fleet would be chaos of our own making), then
+        # one final synchronous scrape before the replicas go away so
         # the store's tail covers the whole run, then stop the thread
         # before drain tears the pool's channels down.
+        if autoscaler is not None:
+            autoscaler.stop()
         scraper.stop()
         srv.shutdown()
         st = router.drain()
         manager.stop()
         st["scrape"] = scraper.stats()
+        if autoscaler is not None:
+            st["autoscale"] = autoscaler.stats()
         store.close()
         obs.close_run()
         print(json.dumps({"fleet_stats": st}))

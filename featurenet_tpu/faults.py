@@ -95,6 +95,16 @@ SITES = {
     # least-queue-depth routing and the p99 gate must ride out.
     "replica_loss": "request",           # Nth routed fleet request
     "replica_slow": "request",           # Nth replica forward dispatch
+    # Rollout sites (zero-downtime weight hot-swap). Both count the
+    # replica's Nth /admin/reload attempt. swap_corrupt hands the swap a
+    # checksum-mismatched checkpoint: the replica must refuse with a
+    # structured error BEFORE any reference flips (never half-swapped)
+    # and the rollout orchestrator must roll already-swapped peers back.
+    # replica_loss_rollout SIGKILLs the replica mid-reload — death at
+    # the worst moment, which the orchestrator must detect and answer
+    # with the same rollback + re-convergence to one version.
+    "swap_corrupt": "swap",              # Nth replica reload attempt
+    "replica_loss_rollout": "swap",      # Nth replica reload attempt
 }
 
 # How long the latency-injection sites (producer_slow, save_slow) sleep

@@ -258,7 +258,7 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
     flat ``fleet_*`` fields the gate pins — sustained QPS and p99 must
     hold THROUGH the loss, and dropped must be zero."""
     from featurenet_tpu.data.synthetic import generate_batch
-    from featurenet_tpu.fleet.replica import ReplicaManager
+    from featurenet_tpu.fleet.replica import Autoscaler, ReplicaManager
     from featurenet_tpu.fleet.router import FleetRouter
     from featurenet_tpu.fleet.scraper import ROUTER_TARGET, MetricsScraper
     from featurenet_tpu.obs import tsdb as _tsdb
@@ -277,6 +277,10 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
             ckpt_dir, slot, hb, run_dir=run_dir,
             exec_cache_dir=cache_dir, buckets=buckets,
             queue_limit=queue_limit,
+            # Full-rate capture rings: the self-rollout below replays a
+            # replica's ring against the SAME checkpoint, so the
+            # rollout_agreement pin has real captured traffic to score.
+            capture=True, capture_sample=1.0,
         )
 
     manager = ReplicaManager(replicas, spawn, run_dir, env=env)
@@ -284,6 +288,7 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
     router = FleetRouter(manager, rules=(), store=store)
     scraper = None
     srv = None
+    autoscaler = None
     try:
         manager.start()
         deadline = time.monotonic() + 300
@@ -310,6 +315,16 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
             interval_s=0.25,
         )
         scraper.start()
+        # The ACTING control loop rides the bench fleet exactly as
+        # `cli fleet --autoscale` wires it. Under handled load the burn
+        # verdicts hold, so fleet_scale_actions is pinned ~0 (abs slack
+        # 1): a regression here means the damping gates rotted and the
+        # roster thrashes under flat load.
+        autoscaler = Autoscaler(
+            manager, router.scale_state,
+            min_replicas=1, max_replicas=replicas + 1,
+        )
+        autoscaler.start()
         grids = generate_batch(np.random.default_rng(0), 16, 16)["voxels"]
         kill_at = max(1, int(n_requests * kill_after_fraction))
         done = threading.Event()
@@ -355,6 +370,48 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
             router.scale_state()
             dt = (time.perf_counter() - t0) * 1e3
             t_best = dt if t_best is None else min(t_best, dt)
+        autoscaler.stop()
+        # The self-rollout pins, on the still-live fleet: hot-swap one
+        # replica to the SAME checkpoint (the swap wall with zero model
+        # delta — pure restore/cast/flip cost) and replay its capture
+        # ring against that checkpoint in a CPU subprocess (agreement
+        # pinned min ≈ 1.0: a model re-scoring its own recorded traffic
+        # must agree with itself).
+        rollout_swap_ms = None
+        rollout_agreement = None
+        ready_ports = {
+            s: p for s, p in manager.stats()["ports"].items()
+        }
+        if ready_ports:
+            slot = sorted(ready_ports)[0]
+            try:
+                st_code, raw, _ra = manager.pool.post(
+                    "127.0.0.1", ready_ports[slot], "/admin/reload",
+                    json.dumps({"checkpoint_dir": ckpt_dir}).encode(),
+                    {"Content-Type": "application/json"}, 120.0,
+                )
+                if st_code == 200:
+                    rollout_swap_ms = json.loads(
+                        raw.decode("utf-8")
+                    ).get("swap_ms")
+            except (OSError, http.client.HTTPException):
+                pass  # degrade to an absent key, like the other probes
+            ring = os.path.join(run_dir, "capture", f"replica{slot}")
+            if os.path.isdir(ring):
+                rp = subprocess.run(
+                    [sys.executable, "-m", "featurenet_tpu.cli",
+                     "replay", ring, "--checkpoint-dir", ckpt_dir,
+                     "--batch", "16"],
+                    env=env, capture_output=True, timeout=600,
+                )
+                for line in rp.stdout.decode(
+                        "utf-8", "replace").splitlines():
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        continue
+                    if "replay" in doc:
+                        rollout_agreement = doc["replay"]["agreement"]
         scraper.stop()
         st = router.drain()
         return {
@@ -385,8 +442,18 @@ def bench_fleet(replicas: int = 2, qps: float = 60.0,
             "fleet_burn_verdict_ms": round(t_best, 3),
             "fleet_scrape_samples": scraper.samples,
             "fleet_scrape_rounds": scraper.rounds,
+            # The acting control loop + rollout pins: scale actions
+            # under handled load (expected 0 — the damping gates), the
+            # live hot-swap wall, and the self-replay agreement.
+            "fleet_scale_actions": autoscaler.actions,
+            **({"rollout_swap_ms": rollout_swap_ms}
+               if rollout_swap_ms is not None else {}),
+            **({"rollout_agreement": rollout_agreement}
+               if rollout_agreement is not None else {}),
         }
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         if scraper is not None:
             scraper.pause(True)
             scraper.stop(final_round=False)

@@ -93,6 +93,13 @@ class _Replica:
         self.was_lost = False  # a later ready is a REJOIN
         self.mon: Optional[HeartbeatMonitor] = None
         self.probe_inflight = False  # one outstanding probe at a time
+        # A deliberately shed slot (autoscale scale-down): the tick loop
+        # neither respawns it nor charges its exit as a loss — shed_one
+        # owns its teardown, add_one may later unpark it.
+        self.parked = False
+        # The model_version the last successful probe reported (the
+        # /healthz tag) — the roster's per-replica deploy identity.
+        self.model_version: Optional[str] = None
 
 
 class ReplicaManager:
@@ -287,7 +294,11 @@ class ReplicaManager:
 
     def _tick(self) -> None:
         now = time.monotonic()
-        for r in self._replicas.values():
+        for r in list(self._replicas.values()):
+            if r.parked:
+                # A shed slot: no respawn, no loss-charging — shed_one
+                # owns its teardown and add_one its revival.
+                continue
             if r.proc is None:
                 if now >= r.respawn_due and self._spawns - self.n \
                         < self.max_respawns:
@@ -346,6 +357,9 @@ class ReplicaManager:
                 if r.port != port:  # lost/respawned while we probed
                     return
                 r.queue_depth = int(health.get("queue_depth") or 0)
+                version = health.get("model_version")
+                if isinstance(version, str):
+                    r.model_version = version
                 first_ready = not r.ready
                 r.ready = True
                 if first_ready:
@@ -354,7 +368,7 @@ class ReplicaManager:
                         self._rejoins += 1
             if first_ready:
                 obs.emit("fleet_replica_ready", replica=r.slot,
-                         port=r.port)
+                         port=r.port, model_version=r.model_version)
                 self._write_roster(
                     "replica_rejoin" if r.was_lost else "start"
                 )
@@ -425,10 +439,93 @@ class ReplicaManager:
         convention — slot 0's event stream stays the primary one."""
         for r in sorted(self._replicas.values(),
                         key=lambda x: -x.slot):
+            if r.parked:
+                continue
             if r.proc is not None and r.proc.poll() is None:
                 _kill_tree(r.proc)
                 return r.slot
         return None
+
+    # -- elastic roster (the autoscaler's levers) -----------------------------
+    def add_one(self) -> int:
+        """Grow the roster by one replica: revive the lowest parked slot
+        if a scale-down left one (its stdout file, heartbeat path, and
+        slot identity are reused), else mint the next slot id. Returns
+        the slot; it joins the candidate set only when its /healthz
+        turns ready, like any spawn. The caller (the autoscaler) owns
+        the max-replicas bound."""
+        with self._lock:
+            parked = sorted(
+                r.slot for r in self._replicas.values() if r.parked
+            )
+            if parked:
+                slot = parked[0]
+                r = self._replicas[slot]
+                r.parked = False
+                r.failures = 0
+                r.respawn_due = 0.0
+            else:
+                slot = max(self._replicas) + 1
+                r = _Replica(slot)
+                self._replicas[slot] = r
+            self.n += 1
+        self._spawn(r)
+        return slot
+
+    def shed_one(self, drain_wait_s: float = 10.0) -> Optional[int]:
+        """Shrink the roster by one: take the HIGHEST ready slot out of
+        the candidate set (new traffic immediately routes to its peers —
+        the router's spillover path covers any request already racing
+        toward it), wait briefly for the router's in-flight count on it
+        to drain, then SIGTERM (a serve child drains its queue on
+        SIGTERM and exits clean). The slot is PARKED, not forgotten: the
+        tick loop neither respawns it nor charges the exit as a loss,
+        and a later ``add_one`` revives it warm from the shared exec
+        cache. Returns the slot, or None when nothing is sheddable. The
+        caller owns the min-replicas bound; the manager only refuses to
+        shed its last replica."""
+        with self._lock:
+            victims = sorted(
+                (r for r in self._replicas.values()
+                 if r.ready and not r.parked),
+                key=lambda x: -x.slot,
+            )
+            live = sum(1 for r in self._replicas.values()
+                       if not r.parked and r.proc is not None)
+            if not victims or live <= 1 or self.n <= 1:
+                return None
+            r = victims[0]
+            r.parked = True
+            r.ready = False
+            self.n -= 1
+        self._write_roster("scale_down")
+        # Drain-through-spillover: the ready flip above already steers
+        # new requests away; in-flight forwards finish on the live
+        # process (or spill over on its 503s). Bounded wait, then the
+        # child's own SIGTERM drain covers the stragglers.
+        deadline = time.monotonic() + drain_wait_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if r.inflight <= 0:
+                    break
+            time.sleep(self.poll_s)
+        proc, port = r.proc, r.port
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=max(drain_wait_s, 5.0))
+            except subprocess.TimeoutExpired:
+                _kill_tree(proc)
+        if port is not None:
+            self.pool.retire_endpoint(self.host, port, "replica_loss")
+        with self._lock:
+            r.proc = None
+            r.port = None
+            r.queue_depth = 0
+        return r.slot
 
     def ready_count(self) -> int:
         with self._lock:
@@ -441,6 +538,9 @@ class ReplicaManager:
                 "ready": sum(
                     1 for r in self._replicas.values() if r.ready
                 ),
+                "parked": sum(
+                    1 for r in self._replicas.values() if r.parked
+                ),
                 "spawns": self._spawns,
                 "losses": self._losses,
                 "rejoins": self._rejoins,
@@ -448,4 +548,160 @@ class ReplicaManager:
                     r.slot: r.port for r in self._replicas.values()
                     if r.port is not None
                 },
+                "versions": {
+                    r.slot: r.model_version
+                    for r in self._replicas.values()
+                    if r.model_version is not None
+                },
             }
+
+
+class Autoscaler:
+    """The acting half of the scale loop: turn the router's advisory
+    ``scale_state()`` verdicts into ``add_one``/``shed_one`` calls, with
+    the damping that keeps a flapping verdict from thrashing the roster.
+
+    Three gates between a verdict and an action, in order:
+
+    - **Honest hold on data absence**: a ``shed`` verdict computed with
+      BOTH burn rates None (no store samples yet, windows still empty)
+      is evidence of missing telemetry, not of idle capacity — it is
+      held, never acted on. Symmetrically, an ``add`` with both burns
+      None AND no queued work is the cold fleet mid-warmup (the
+      empty-roster verdict), not demand — held too. An ``add`` backed
+      by a deep queue stands even without burn data: queued work is
+      direct observation.
+    - **Hysteresis**: the same actionable verdict must hold for
+      ``hysteresis`` consecutive evaluations (alert-style sustain) —
+      one noisy tick never moves the roster.
+    - **Action cooldown**: at least ``cooldown_s`` must have elapsed
+      since the LAST ACTION — not since the last verdict change — so an
+      oscillating verdict (add, hold, add, hold …) cannot fire on every
+      rising edge.
+
+    Bounds: never below ``min_replicas``, never above ``max_replicas``
+    (a verdict at a bound is silently refused — no action, no cooldown).
+    Every action taken is a ``fleet_autoscale`` event with
+    ``{action, from_n, to_n, reason}``. ``step()`` is pure
+    state-machine (caller supplies the clock) so the flap tests drive
+    oscillating series without threads; ``start()`` runs it on the
+    manager-owned control thread."""
+
+    def __init__(self, manager, scale_state: Callable[[], dict], *,
+                 min_replicas: int = 1,
+                 max_replicas: int = 8,
+                 hysteresis: int = 3,
+                 cooldown_s: float = 30.0,
+                 interval_s: float = 1.0):
+        if min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}"
+            )
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) must be >= "
+                f"min_replicas ({min_replicas})"
+            )
+        self.manager = manager
+        self.scale_state = scale_state
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.hysteresis = max(1, int(hysteresis))
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        self.actions = 0
+        self._streak_verdict = "hold"
+        self._streak = 0
+        self._last_action_t: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the decision step (threadless; the unit tests drive this) -----------
+    def step(self, state: dict, now: float) -> Optional[dict]:
+        """Evaluate one scale_state snapshot at time ``now``; returns
+        the action record (also emitted as ``fleet_autoscale``) when the
+        roster moved, else None."""
+        verdict = state.get("verdict", "hold")
+        burn_fast = state.get("burn_fast")
+        burn_slow = state.get("burn_slow")
+        if verdict == "shed" and burn_fast is None and burn_slow is None:
+            # Shedding wants positive evidence of idle capacity; two
+            # None burns mean the telemetry isn't there yet.
+            verdict = "hold"
+        if verdict == "add" and burn_fast is None and burn_slow is None \
+                and (state.get("queue_depth") or 0) <= 0:
+            # Adding wants positive evidence of DEMAND (burn or queued
+            # work). A bare empty-roster add with neither is the cold
+            # fleet mid-warmup — spawning more replicas into a warmup
+            # doesn't serve anyone sooner; the manager's respawn path
+            # already owns actually-dead rosters.
+            verdict = "hold"
+        if verdict == self._streak_verdict:
+            self._streak += 1
+        else:
+            self._streak_verdict = verdict
+            self._streak = 1
+        if verdict not in ("add", "shed"):
+            return None
+        if self._streak < self.hysteresis:
+            return None
+        if self._last_action_t is not None and \
+                now - self._last_action_t < self.cooldown_s:
+            return None
+        from_n = self.manager.n
+        reason = (
+            f"sustained_{verdict}(streak={self._streak},"
+            f"burn_fast={burn_fast},burn_slow={burn_slow},"
+            f"queue_depth={state.get('queue_depth')})"
+        )
+        if verdict == "add":
+            if from_n >= self.max_replicas:
+                return None
+            self.manager.add_one()
+        else:
+            if from_n <= self.min_replicas:
+                return None
+            if self.manager.shed_one() is None:
+                return None
+        to_n = self.manager.n
+        self._last_action_t = now
+        self._streak = 0
+        self.actions += 1
+        action = {"action": verdict, "from_n": from_n, "to_n": to_n,
+                  "reason": reason}
+        obs.emit("fleet_autoscale", action=verdict, from_n=from_n,
+                 to_n=to_n, reason=reason)
+        return action
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(2.0, 2 * self.interval_s))
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step(self.scale_state(), time.monotonic())
+            except Exception as e:  # the control loop must outlive a tick
+                obs.warn("fleet_autoscale_error", repr(e)[:300])
+            self._stop.wait(self.interval_s)
+
+    def stats(self) -> dict:
+        return {
+            "actions": self.actions,
+            "streak": self._streak,
+            "streak_verdict": self._streak_verdict,
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+        }

@@ -237,13 +237,30 @@ class MetricsScraper:
                               {"replica": target}, t=now)
             return 0
         appended = 0
-        for name, labels, value in parse_exposition(text):
+        samples = parse_exposition(text)
+        # The target's model_version (its build_info labels) becomes a
+        # ``version`` label on every series scraped THIS round from this
+        # target — during a rolling weight swap the store shows the
+        # mixed-version window per replica, and a dashboard can split
+        # any latency series by deploy. The router exports "n/a" (it
+        # owns no checkpoint); that is not a version, so no label.
+        version = None
+        for name, labels, _value in samples:
+            base = name[len(_PREFIX):] if name.startswith(_PREFIX) else name
+            if base == "build_info":
+                v = labels.get("model_version")
+                if v and v != "n/a":
+                    version = v
+                break
+        for name, labels, value in samples:
             base = name[len(_PREFIX):] if name.startswith(_PREFIX) else name
             if base not in self.registry:
                 self.skipped += 1
                 continue
             labels = dict(labels)
             labels["replica"] = target
+            if version is not None:
+                labels["version"] = version
             if self.store.append(base, value, labels, t=now):
                 appended += 1
         dur_ms = (time.perf_counter() - t0) * 1e3

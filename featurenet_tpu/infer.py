@@ -51,6 +51,81 @@ class SegPrediction:
     labels: np.ndarray = dataclasses.field(repr=False)
 
 
+@dataclasses.dataclass
+class _Weights:
+    """One generation of serving weights, bundled so a hot swap is a
+    SINGLE reference flip: ``forward_padded`` reads ``self._weights``
+    once per dispatch and every tree it hands the program comes from
+    that one read — a swap landing between two dispatches can never
+    produce a torn forward (old params, new scales)."""
+    params: object            # fp32 masters (agreement gate, re-cast source)
+    stats: object             # batch-norm stats
+    serve_params: object      # what the serve program reads (bf16 copy or alias)
+    qparams: object           # int8 precision only, else None
+    scales: object            # int8 precision only, else None
+    version: str              # model_version tag this generation serves
+
+
+def checkpoint_version(checkpoint_dir: str, step) -> str:
+    """The human-readable model_version tag for a checkpoint directory:
+    ``<dirname>@<step>-<sidecar sha256 prefix>``. The digest comes from
+    the save-time checksum sidecar, so two directories holding the same
+    step number but different bytes get distinct tags; legacy dirs
+    without a sidecar fall back to ``<dirname>@<step>``."""
+    import hashlib
+    import os
+
+    from featurenet_tpu.train.checkpoint import _checksum_path
+
+    base = os.path.basename(os.path.normpath(os.path.abspath(checkpoint_dir)))
+    if step is None:
+        return base
+    tag = f"{base}@{int(step)}"
+    try:
+        with open(_checksum_path(checkpoint_dir, int(step)), "rb") as fh:
+            return f"{tag}-{hashlib.sha256(fh.read()).hexdigest()[:8]}"
+    except OSError:
+        return tag
+
+
+def _restore_for_serving(checkpoint_dir: str, config=None):
+    """Restore a checkpoint's weights for serving: the shared walk under
+    ``Predictor.from_checkpoint`` (cold start) and
+    ``Predictor.swap_params`` (hot swap — ``config`` is then the LIVE
+    config, so an identity-mismatched candidate raises before any state
+    changes). Returns ``(state, cfg, model_version)``."""
+    import jax
+
+    from featurenet_tpu.config import check_identity
+    from featurenet_tpu.runtime import build_model
+    from featurenet_tpu.train.checkpoint import (
+        CheckpointManager,
+        load_run_config,
+    )
+    from featurenet_tpu.train.state import create_state
+    from featurenet_tpu.train.steps import make_optimizer
+
+    saved = load_run_config(checkpoint_dir)
+    if config is None:
+        cfg = saved if saved is not None else get_config("pod64")
+    else:
+        cfg = get_config(config) if isinstance(config, str) else config
+        if saved is not None:
+            check_identity(saved, cfg)
+    model = build_model(cfg)
+    sample = np.zeros(
+        (1, cfg.resolution, cfg.resolution, cfg.resolution, 1), np.float32
+    )
+    state = create_state(
+        model, make_optimizer(cfg), sample, jax.random.key(0)
+    )
+    mgr = CheckpointManager(checkpoint_dir)
+    state = mgr.restore(state)
+    version = checkpoint_version(checkpoint_dir, mgr.latest_step())
+    mgr.close()
+    return state, cfg, version
+
+
 class Predictor:
     """Fixed-shape compiled serving forward over a trained checkpoint.
 
@@ -75,7 +150,9 @@ class Predictor:
     """
 
     def __init__(self, params, batch_stats, cfg: Config, batch: int = 32,
-                 precision: str | None = None):
+                 precision: str | None = None,
+                 model_version: str = "unversioned",
+                 checkpoint_dir: str | None = None):
         from featurenet_tpu.runtime import Runtime
         from featurenet_tpu.runtime.registry import PRECISIONS
 
@@ -91,6 +168,7 @@ class Predictor:
         self.cfg = cfg
         self.batch = batch
         self.precision = precision
+        self.checkpoint_dir = checkpoint_dir
         # Single-device by design (a ~5M-param model never needs a serving
         # mesh), so the Runtime gets an explicit 1x1 mesh: a checkpoint
         # trained with a pod-scale mesh_data/mesh_model must restore and
@@ -100,29 +178,11 @@ class Predictor:
         from featurenet_tpu.parallel.mesh import make_mesh
 
         dev = jax.devices()[0]
+        self._device = dev
         self.rt = Runtime(cfg, mesh=make_mesh(1, 1, devices=[dev]))
         self.model = self.rt.model
-        # Weights handed over from a mesh-sharded Trainer state are
-        # gathered onto the serving device here.
-        self._params = jax.device_put(params, dev)
-        self._stats = jax.device_put(batch_stats, dev)
-        if precision == "int8":
-            from featurenet_tpu.runtime.quantize import quantize_tree
-
-            # Quantize once at construction; the program dequantizes on
-            # device, so int8 is what sits in serving HBM.
-            self._qparams, self._scales = quantize_tree(self._params)
-        # The tree the serve program reads per dispatch: the fp32
-        # masters under fp32, a bf16 WORKING COPY cast once HERE under
-        # bf16 — so 2-byte weights are what the program's avals name and
-        # what HBM serves on every request (the int8 path's
-        # transform-at-construction pattern; masters stay fp32 beside it
-        # for the agreement gate and re-precision).
-        self._serve_params = self._params
-        if precision == "bf16":
-            from featurenet_tpu.train.precision import serve_params_cast
-
-            self._serve_params = serve_params_cast(self._params, "bf16")
+        self._weights = self._build_weights(params, batch_stats,
+                                            model_version)
         # One executable per compile batch, memoized: the batch-mode API
         # uses exactly one (``batch``), the serving front end
         # (featurenet_tpu.serve) warms one per bucket in its ladder.
@@ -134,6 +194,84 @@ class Predictor:
         from featurenet_tpu.obs import perf as _perf
 
         self._peaks = _perf.local_device_peaks()
+
+    def _build_weights(self, params, batch_stats, version: str) -> _Weights:
+        """Device-put + precision-transform one generation of weights —
+        the construction-time path AND the hot-swap path (a swap pays
+        exactly the cost of a cold construction's weight prep, while the
+        old generation keeps serving)."""
+        import jax
+
+        # Weights handed over from a mesh-sharded Trainer state are
+        # gathered onto the serving device here.
+        dparams = jax.device_put(params, self._device)
+        dstats = jax.device_put(batch_stats, self._device)
+        qparams = scales = None
+        if self.precision == "int8":
+            from featurenet_tpu.runtime.quantize import quantize_tree
+
+            # Quantize once at construction; the program dequantizes on
+            # device, so int8 is what sits in serving HBM.
+            qparams, scales = quantize_tree(dparams)
+        # The tree the serve program reads per dispatch: the fp32
+        # masters under fp32, a bf16 WORKING COPY cast once HERE under
+        # bf16 — so 2-byte weights are what the program's avals name and
+        # what HBM serves on every request (the int8 path's
+        # transform-at-construction pattern; masters stay fp32 beside it
+        # for the agreement gate and re-precision).
+        serve_params = dparams
+        if self.precision == "bf16":
+            from featurenet_tpu.train.precision import serve_params_cast
+
+            serve_params = serve_params_cast(dparams, "bf16")
+        return _Weights(params=dparams, stats=dstats,
+                        serve_params=serve_params, qparams=qparams,
+                        scales=scales, version=version)
+
+    # The per-generation trees read through the live bundle, so every
+    # consumer (agreement gate, tests, the quality prober) follows a
+    # swap automatically.
+    @property
+    def _params(self):
+        return self._weights.params
+
+    @property
+    def _stats(self):
+        return self._weights.stats
+
+    @property
+    def _serve_params(self):
+        return self._weights.serve_params
+
+    @property
+    def _qparams(self):
+        return self._weights.qparams
+
+    @property
+    def _scales(self):
+        return self._weights.scales
+
+    @property
+    def model_version(self) -> str:
+        return self._weights.version
+
+    def swap_params(self, checkpoint_dir: str) -> str:
+        """Hot-swap the serving weights to another checkpoint of the SAME
+        model identity, with zero downtime: restore + device-put + cast /
+        quantize happen on the CALLER's thread against the existing AOT
+        programs (params are call arguments, so no executable is touched),
+        then the new generation lands as one atomic reference flip —
+        dispatches in flight finish on the old weights, the next dispatch
+        reads the new ones, and no intermediate state is ever visible.
+        Raises (identity mismatch, corrupt checkpoint) BEFORE the flip:
+        a failed swap leaves the replica serving the old generation.
+        Returns the new ``model_version``."""
+        state, cfg, version = _restore_for_serving(checkpoint_dir,
+                                                   config=self.cfg)
+        new = self._build_weights(state.params, state.batch_stats, version)
+        self._weights = new
+        self.checkpoint_dir = checkpoint_dir
+        return version
 
     def program_for(self, batch: int):
         """The ``serve``/``serve_bf16``/``serve_int8`` executable at this
@@ -160,9 +298,12 @@ class Predictor:
         prog = self.program_for(
             batch if batch is not None else voxels.shape[0]
         )
+        # ONE read of the live bundle per dispatch: a concurrent
+        # swap_params flip cannot mix generations within a forward.
+        w = self._weights
         if self.precision == "int8":
-            return prog(self._qparams, self._scales, self._stats, voxels)
-        return prog(self._serve_params, self._stats, voxels)
+            return prog(w.qparams, w.scales, w.stats, voxels)
+        return prog(w.serve_params, w.stats, voxels)
 
     def _forward(self, voxels):
         return self.forward_padded(voxels, self.batch)
@@ -215,36 +356,11 @@ class Predictor:
         The optimizer state in the checkpoint is restored (Orbax needs the
         full tree) and immediately dropped — inference keeps weights only.
         """
-        import jax
-
-        from featurenet_tpu.config import check_identity
-        from featurenet_tpu.runtime import build_model
-        from featurenet_tpu.train.checkpoint import (
-            CheckpointManager,
-            load_run_config,
-        )
-        from featurenet_tpu.train.state import create_state
-        from featurenet_tpu.train.steps import make_optimizer
-
-        saved = load_run_config(checkpoint_dir)
-        if config is None:
-            cfg = saved if saved is not None else get_config("pod64")
-        else:
-            cfg = get_config(config) if isinstance(config, str) else config
-            if saved is not None:
-                check_identity(saved, cfg)
-        model = build_model(cfg)
-        sample = np.zeros(
-            (1, cfg.resolution, cfg.resolution, cfg.resolution, 1), np.float32
-        )
-        state = create_state(
-            model, make_optimizer(cfg), sample, jax.random.key(0)
-        )
-        mgr = CheckpointManager(checkpoint_dir)
-        state = mgr.restore(state)
-        mgr.close()
+        state, cfg, version = _restore_for_serving(checkpoint_dir,
+                                                   config=config)
         return cls(state.params, state.batch_stats, cfg, batch=batch,
-                   precision=precision)
+                   precision=precision, model_version=version,
+                   checkpoint_dir=checkpoint_dir)
 
     # -- prediction ---------------------------------------------------------
     def predict_voxels(

@@ -46,6 +46,12 @@ _COLUMNS = (
     ("fleet_conn_reuse_ratio", "fl_reuse", "{:.2f}"),
     ("scrape_overhead_pct", "scrape_%", "{:.1f}"),
     ("fleet_burn_verdict_ms", "burn_ms", "{:.1f}"),
+    # The acting control loop + rollout pins (the autoscale/rollout
+    # trajectory: actions taken under flat load — expected 0 — plus the
+    # hot-swap wall and the self-rollout's replay-canary agreement).
+    ("fleet_scale_actions", "scale_act", "{:.0f}"),
+    ("rollout_swap_ms", "swap_ms", "{:.0f}"),
+    ("rollout_agreement", "roll_agr", "{:.3f}"),
 )
 
 

@@ -891,7 +891,9 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
     # timeline is the mesh_reform-style roster history: who was lost
     # why, and when each respawn turned ready again.
     fl = [e for e in events
-          if isinstance(e.get("ev"), str) and e["ev"].startswith("fleet_")]
+          if isinstance(e.get("ev"), str)
+          and (e["ev"].startswith("fleet_")
+               or e["ev"].startswith("rollout_") or e["ev"] == "swap")]
     if fl:
         starts = [e for e in fl if e["ev"] == "fleet_start"]
         stops = [e for e in fl if e["ev"] == "fleet_stop"]
@@ -905,6 +907,13 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
             if e["ev"] == "fleet_scale":
                 v = str(e.get("verdict", "?"))
                 verdicts[v] = verdicts.get(v, 0) + 1
+        # Actions actually TAKEN (fleet_autoscale), as opposed to the
+        # advisory verdict changes counted above.
+        autoscale: dict[str, int] = {}
+        for e in fl:
+            if e["ev"] == "fleet_autoscale":
+                a = str(e.get("action", "?"))
+                autoscale[a] = autoscale.get(a, 0) + 1
         fleet: dict = {
             "replicas": starts[-1].get("replicas") if starts else None,
             "ready_events": sum(
@@ -924,9 +933,27 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
                 for e in fl
                 if e["ev"] in ("fleet_start", "fleet_replica_ready",
                                "fleet_replica_loss", "fleet_scale",
-                               "fleet_stop")
+                               "fleet_autoscale", "fleet_stop", "swap",
+                               "rollout_start", "rollout_step",
+                               "rollout_rollback", "rollout_done")
             ],
         }
+        if autoscale:
+            fleet["autoscale_actions"] = autoscale
+        # The rollout arc, when one ran: the orchestrator's verdict plus
+        # the per-replica swap attempts (the mixed-version window reads
+        # off the timeline's model_version tags).
+        swaps = [e for e in fl if e["ev"] == "swap"]
+        dones = [e for e in fl if e["ev"] == "rollout_done"]
+        rollbacks = [e for e in fl if e["ev"] == "rollout_rollback"]
+        if swaps or dones or rollbacks:
+            fleet["rollout"] = {
+                "swaps_ok": sum(bool(e.get("ok")) for e in swaps),
+                "swaps_refused": sum(not e.get("ok") for e in swaps),
+                "rollbacks": len(rollbacks),
+                "ok": bool(dones[-1].get("ok")) if dones else None,
+                "version": dones[-1].get("version") if dones else None,
+            }
         if stops:
             fleet["routed"] = stops[-1].get("routed")
             fleet["answered"] = stops[-1].get("answered")
@@ -1672,6 +1699,19 @@ KNOWN_EVENT_KINDS = frozenset({
     "fleet_start", "fleet_replica_ready", "fleet_replica_loss",
     "fleet_spillover", "fleet_resubmit", "fleet_shed", "fleet_scale",
     "fleet_stop",
+    # The acting control loop (fleet.replica.Autoscaler): the roster
+    # actually moved — an add or shed taken on a SUSTAINED verdict after
+    # hysteresis + cooldown damping (fleet_scale above stays the
+    # advisory verdict-change record).
+    "fleet_autoscale",
+    # Zero-downtime weight rollout: one replica's live hot-swap attempt
+    # (ok either way — a refused swap is an event too), and the
+    # orchestrator's arc — rollout began over N replicas, one replica
+    # finished its canary+swap step, already-swapped replicas were
+    # rolled back (canary failure / replica death mid-rollout), rollout
+    # finished with its converged version.
+    "swap", "rollout_start", "rollout_step", "rollout_rollback",
+    "rollout_done",
     # Persistent-connection data plane (fleet.pool): a fresh channel
     # opened (carrying its connect_ms — the handshake cost pooling
     # amortizes), an idle keep-alive channel reused, and a channel
@@ -1730,7 +1770,13 @@ REQUIRED_EVENT_FIELDS = {
     "fleet_resubmit": ("trace", "from_replica"),
     "fleet_shed": ("lane",),
     "fleet_scale": ("verdict",),
+    "fleet_autoscale": ("action", "from_n", "to_n", "reason"),
     "fleet_stop": ("routed", "dropped"),
+    "swap": ("ok", "from_version", "swap_ms"),
+    "rollout_start": ("checkpoint_dir", "replicas"),
+    "rollout_step": ("replica", "ok"),
+    "rollout_rollback": ("reason", "rolled_back"),
+    "rollout_done": ("ok", "swapped"),
     "conn_open": ("endpoint",),
     "conn_reuse": ("endpoint",),
     "conn_retire": ("endpoint", "reason"),

@@ -92,8 +92,8 @@ DEFAULT_REQUEST_TIMEOUT_S = 60.0
 PRIORITY_HEADER = "X-Featurenet-Priority"
 
 _ENDPOINTS = ["POST /predict", "POST /predict_voxels",
-              "POST /predict_voxels_stream", "GET /stats",
-              "GET /healthz", "GET /metrics"]
+              "POST /predict_voxels_stream", "POST /admin/reload",
+              "GET /stats", "GET /healthz", "GET /metrics"]
 
 # A frame trace id is "<stream>.<frame index>" and must still satisfy
 # the trace-id grammar (≤64 chars): adopt the caller's stream id only
@@ -207,6 +207,9 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
             if self.path == "/predict_voxels_stream":
                 self._stream()
                 return
+            if self.path == "/admin/reload":
+                self._admin_reload()
+                return
             if self.path not in ("/predict", "/predict_voxels"):
                 # Drain the body before answering: an unread body on a
                 # keep-alive channel would be parsed as the NEXT
@@ -277,6 +280,43 @@ def make_server(service, host: str = "127.0.0.1", port: int = 0,
                 return
             self._json(200, service.format_row(row),
                        trace_id=fut.trace_id)
+
+        def _admin_reload(self) -> None:
+            """``POST /admin/reload {"checkpoint_dir": ...}``: the
+            zero-downtime weight hot-swap (``InferenceService.reload``).
+            200 with the new ``model_version`` on success; 409 with a
+            structured refusal when the swap is rejected (checksum
+            mismatch, identity mismatch, unreadable checkpoint) — the
+            replica then still serves the OLD generation, and the body
+            says which one."""
+            length = int(self.headers.get("Content-Length") or 0)
+            data = self.rfile.read(length)
+            try:
+                payload = json.loads(data.decode("utf-8")) if data else {}
+            except ValueError as e:
+                self._json(400, {"error": "bad_json", "detail": str(e)})
+                return
+            ckpt = payload.get("checkpoint_dir") \
+                if isinstance(payload, dict) else None
+            if not isinstance(ckpt, str) or not ckpt:
+                self._json(400, {
+                    "error": "bad_reload",
+                    "detail": 'body must be {"checkpoint_dir": "<path>"}',
+                })
+                return
+            try:
+                out = service.reload(ckpt)
+            except Exception as e:
+                self._json(409, self._reject_body({
+                    "error": "swap_refused",
+                    "kind": type(e).__name__,
+                    "detail": str(e),
+                    "model_version": getattr(
+                        service.predictor, "model_version", "unversioned"
+                    ),
+                }))
+                return
+            self._json(200, self._reject_body(out))
 
         # -- the streamed multi-part protocol ------------------------------
         def _read_exact(self, n: int) -> bytes:

@@ -126,7 +126,8 @@ def _escape_label(v: str) -> str:
             .replace("\n", "\\n"))
 
 
-def _build_info_labels(serve_precision: str, conv_backend: str) -> str:
+def _build_info_labels(serve_precision: str, conv_backend: str,
+                       model_version: str = "n/a") -> str:
     try:
         import jax
         jax_version = getattr(jax, "__version__", "unknown")
@@ -135,7 +136,8 @@ def _build_info_labels(serve_precision: str, conv_backend: str) -> str:
     return (
         f'{{jax_version="{_escape_label(jax_version)}",'
         f'serve_precision="{_escape_label(serve_precision)}",'
-        f'conv_backend="{_escape_label(conv_backend)}"}}'
+        f'conv_backend="{_escape_label(conv_backend)}",'
+        f'model_version="{_escape_label(model_version)}"}}'
     )
 
 
@@ -184,6 +186,10 @@ def render_metrics(service) -> str:
     row("build_info", 1, _build_info_labels(
         getattr(cfg, "serve_precision", "unknown"),
         getattr(getattr(cfg, "arch", None), "conv_backend", "unknown"),
+        model_version=getattr(
+            getattr(service, "predictor", None),
+            "model_version", "unversioned",
+        ),
     ), kind="gauge")
 
     health = service.health()

@@ -31,6 +31,9 @@ so CI can gate on latency regressions the same way it gates on accuracy.
 
 from __future__ import annotations
 
+import os
+import signal
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -114,6 +117,16 @@ class InferenceService:
         # forward drags — the latency failure mode the fleet's p99 gate
         # must survive, distinct from replica death).
         self._forwards = 0
+        # Reload ordinal (the swap_corrupt / replica_loss_rollout fault
+        # counters) + a lock so two concurrent /admin/reload calls can't
+        # interleave restore work; the dispatch path never takes it.
+        self._swaps = 0
+        self._swap_lock = threading.Lock()
+        # Rollout cordon: readiness drops while a hot-swap's restore/
+        # cast runs so the fleet router steers new traffic to peers
+        # (drain via spillover); requests already here keep being
+        # answered by the OLD weights until the atomic flip.
+        self._reload_cordon = False
         # Readiness (the /healthz split): a server is ready only between
         # warmup completing and drain beginning — today a warming or
         # draining process would answer "healthy" to a router probing
@@ -295,8 +308,17 @@ class InferenceService:
 
     def ready(self) -> bool:
         """True only between warmup completing and drain beginning —
-        the /healthz readiness verdict a fleet router keys traffic off."""
-        return self._ready
+        the /healthz readiness verdict a fleet router keys traffic off.
+        Also False for the duration of a weight hot-swap: the router
+        drains the replica through its spillover path while the new
+        generation is restored and cast."""
+        return self._ready and not self._reload_cordon
+
+    def reloading(self) -> bool:
+        """True while ``reload`` is mid-swap — the replica is cordoned
+        (not ready) but alive and working, so liveness heartbeats must
+        keep beating."""
+        return self._reload_cordon
 
     def health(self) -> dict:
         """The /healthz payload: the readiness split plus uptime and
@@ -310,7 +332,89 @@ class InferenceService:
         }
         if self.replica is not None:
             out["replica"] = self.replica
+        # Version tags (the rollout plane): which weights THIS replica is
+        # serving right now, and where they came from — the orchestrator
+        # reads the mixed-version window straight off /healthz, and the
+        # checkpoint_dir is what a rollback re-submits.
+        out["model_version"] = getattr(
+            self.predictor, "model_version", "unversioned"
+        )
+        ckpt = getattr(self.predictor, "checkpoint_dir", None)
+        if ckpt is not None:
+            out["checkpoint_dir"] = ckpt
         return out
+
+    def reload(self, checkpoint_dir: str) -> dict:
+        """Zero-downtime weight hot-swap (`POST /admin/reload`): verify
+        the candidate checkpoint's checksum sidecar, then flip the
+        predictor's serving weights via ``Predictor.swap_params`` — the
+        restore/cast work runs HERE (an HTTP worker thread), never the
+        dispatch thread, and the flip is one atomic reference move, so
+        requests keep being answered throughout. Any failure (checksum
+        mismatch, identity mismatch, unreadable checkpoint) raises
+        BEFORE the flip: the replica is never half-swapped, it keeps
+        serving the old generation and the caller gets a structured
+        refusal. Every attempt — either way — is a ``swap`` event."""
+        from featurenet_tpu.train.checkpoint import (
+            CheckpointManager,
+            ChecksumMismatch,
+        )
+
+        with self._swap_lock:
+            self._swaps += 1
+            n = self._swaps
+            from_version = getattr(
+                self.predictor, "model_version", "unversioned"
+            )
+            if faults.maybe_fail("replica_loss_rollout", swap=n):
+                # Death mid-reload, no drain — the rollout orchestrator's
+                # worst case: the replica vanishes while nominally
+                # swapping, the manager respawns it on the OLD argv, and
+                # the orchestrator must detect and roll peers back.
+                os.kill(os.getpid(), signal.SIGKILL)
+            t0 = time.perf_counter()
+            self._reload_cordon = True
+            try:
+                mgr = CheckpointManager(checkpoint_dir)
+                try:
+                    step = mgr.latest_step()
+                    if step is None:
+                        raise ValueError(
+                            "no finalized checkpoint step in "
+                            f"{checkpoint_dir!r}"
+                        )
+                    if faults.maybe_fail("swap_corrupt", swap=n):
+                        # The candidate arrives checksum-mismatched (bit
+                        # rot / torn copy on the deploy path) — same
+                        # refusal the real verification below raises.
+                        raise ChecksumMismatch(
+                            "injected swap_corrupt: candidate checkpoint "
+                            "fails content verification"
+                        )
+                    mgr.verify(step)
+                finally:
+                    mgr.close()
+                version = self.predictor.swap_params(checkpoint_dir)
+            except Exception as e:
+                self._reload_cordon = False
+                obs.emit(
+                    "swap", ok=False, from_version=from_version,
+                    swap_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                    checkpoint_dir=str(checkpoint_dir),
+                    error=f"{type(e).__name__}: {e}",
+                )
+                raise
+            self._reload_cordon = False
+            swap_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            obs.emit("swap", ok=True, from_version=from_version,
+                     swap_ms=swap_ms, to_version=version,
+                     checkpoint_dir=str(checkpoint_dir))
+            return {
+                "ok": True,
+                "model_version": version,
+                "from_version": from_version,
+                "swap_ms": swap_ms,
+            }
 
     def drain(self, timeout_s: float = 30.0) -> dict:
         """Stop accepting, answer everything admitted, flush the final

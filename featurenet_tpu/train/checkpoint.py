@@ -525,6 +525,20 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def verify(self, step: Optional[int] = None) -> Optional[int]:
+        """Content-verify one step (default: latest) against its
+        save-time checksum sidecar WITHOUT restoring it — the rollout
+        hot-swap's admission check: a candidate that fails here is
+        refused before any weights move. Raises ``ChecksumMismatch`` on
+        disagreement; returns the verified step (None when the directory
+        holds no finalized step). Legacy dirs without a sidecar pass, as
+        on restore."""
+        s = step if step is not None else self._mgr.latest_step()
+        if s is None:
+            return None
+        self._verify_checksums(int(s))
+        return int(s)
+
     def wait(self) -> None:
         with obs.span("checkpoint_wait"):
             self._drain()

@@ -7,16 +7,28 @@ CPU fleet under open-loop HTTP load that survives a ``replica_loss``
 injection with zero admitted-request drops, a roster timeline in the
 report, and the killed replica rejoining from the fleet-shared exec
 cache with zero fresh compiles.
+
+ISSUE 18 closes the control loop: the ``Autoscaler`` state machine
+(hysteresis, honest hold, cooldown-since-last-ACTION), the manager's
+``add_one``/``shed_one`` park-and-revive levers, live ``swap_params``
+hot-swap + ``POST /admin/reload``, the scraper's per-target ``version``
+label, and the chaos-gate e2es: autoscale-on-load-ramp, a rolling
+``cli fleet rollout`` with canary verdicts and a forced ``swap_corrupt``
+rollback, and a replica death mid-rollout re-converging to one version.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import socket
+import subprocess
 import sys
 import threading
 import time
+import urllib.error
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -30,7 +42,11 @@ from featurenet_tpu.elastic.membership import (
     signal_ready,
     write_membership,
 )
-from featurenet_tpu.fleet.replica import Candidate, ReplicaManager
+from featurenet_tpu.fleet.replica import (
+    Autoscaler,
+    Candidate,
+    ReplicaManager,
+)
 from featurenet_tpu.fleet.router import FleetRouter, scale_verdict
 from featurenet_tpu.obs.report import (
     build_report,
@@ -1132,3 +1148,887 @@ def test_fleet_e2e_burn_rate_scrape_alert_and_dash(
     assert tl and ROUTER_TARGET in tl["targets"]
     assert tl["targets"]["1"]["samples"] > 0
     assert "fleet timeline" in format_report(rep)
+
+
+# --- ISSUE 18: the acting autoscaler (unit) ----------------------------------
+
+class _ScaleManagerFake:
+    """The two levers the Autoscaler pulls, scripted: counts calls,
+    optionally refuses to shed (the manager's last-replica guard)."""
+
+    def __init__(self, n: int = 2, shed_refuses: bool = False):
+        self.n = n
+        self.calls: list = []
+        self.shed_refuses = shed_refuses
+
+    def add_one(self):
+        self.calls.append("add")
+        self.n += 1
+        return self.n - 1
+
+    def shed_one(self, drain_wait_s: float = 10.0):
+        if self.shed_refuses:
+            return None
+        self.calls.append("shed")
+        self.n -= 1
+        return self.n
+
+
+def _scale_st(verdict, bf=2.0, bs=1.5, qd=0.0):
+    return {"verdict": verdict, "burn_fast": bf, "burn_slow": bs,
+            "queue_depth": qd, "replicas": 2}
+
+
+def test_autoscaler_validation():
+    m = _ScaleManagerFake()
+    with pytest.raises(ValueError, match="min_replicas"):
+        Autoscaler(m, lambda: {}, min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        Autoscaler(m, lambda: {}, min_replicas=4, max_replicas=3)
+
+
+def test_autoscaler_hysteresis_and_honest_hold():
+    # Hysteresis: two adds + an interruption never act; three in a row
+    # do, exactly once, with the sustained reason on the record.
+    m = _ScaleManagerFake(n=2)
+    a = Autoscaler(m, lambda: {}, min_replicas=1, max_replicas=8,
+                   hysteresis=3, cooldown_s=0.0)
+    assert a.step(_scale_st("add"), 0.0) is None
+    assert a.step(_scale_st("add"), 1.0) is None
+    assert a.step(_scale_st("hold"), 2.0) is None  # streak broken
+    assert a.step(_scale_st("add"), 3.0) is None
+    assert a.step(_scale_st("add"), 4.0) is None
+    act = a.step(_scale_st("add"), 5.0)
+    assert act is not None
+    assert (act["action"], act["from_n"], act["to_n"]) == ("add", 2, 3)
+    assert act["reason"].startswith("sustained_add(")
+    assert m.calls == ["add"] and a.actions == 1
+    # Honest hold: a shed verdict with BOTH burns None is missing
+    # telemetry, not idle capacity — it never acts, however sustained.
+    m2 = _ScaleManagerFake(n=3)
+    a2 = Autoscaler(m2, lambda: {}, min_replicas=1, max_replicas=8,
+                    hysteresis=1, cooldown_s=0.0)
+    for t in range(5):
+        assert a2.step(_scale_st("shed", bf=None, bs=None), float(t)) \
+            is None
+    assert m2.calls == []
+    assert a2.stats()["streak_verdict"] == "hold"
+    # ...while a shed with real burn data stands...
+    act = a2.step(_scale_st("shed", bf=0.02, bs=0.01), 6.0)
+    assert act is not None and act["action"] == "shed"
+    assert m2.calls == ["shed"]
+    # ...a naked add (no burns, nothing queued — the cold fleet
+    # mid-warmup shape) is equally held: absence of capacity is not
+    # evidence of demand...
+    m3 = _ScaleManagerFake(n=2)
+    a3 = Autoscaler(m3, lambda: {}, min_replicas=1, max_replicas=8,
+                    hysteresis=1, cooldown_s=0.0)
+    for t in range(5):
+        assert a3.step(_scale_st("add", bf=None, bs=None, qd=0.0),
+                       float(t)) is None
+    assert m3.calls == []
+    # ...but a burn-less ADD backed by a deep queue stands (queued work
+    # is direct observation, not absence).
+    m4 = _ScaleManagerFake(n=1)
+    a4 = Autoscaler(m4, lambda: {}, min_replicas=1, max_replicas=8,
+                    hysteresis=2, cooldown_s=0.0)
+    assert a4.step(_scale_st("add", bf=None, bs=None, qd=20.0), 0.0) \
+        is None
+    act = a4.step(_scale_st("add", bf=None, bs=None, qd=20.0), 1.0)
+    assert act is not None and act["action"] == "add"
+
+
+def test_autoscaler_cooldown_elapses_since_last_action_not_verdict():
+    """The flap fix: an oscillating verdict (add, hold, add, hold, ...)
+    re-arms a change-based cooldown on every rising edge and thrashes;
+    the cooldown must run from the last ACTION. At hysteresis=1 and a
+    30 s cooldown over 70 oscillating 1 s ticks, a correct clock fires
+    at exactly t=0, 30, 60."""
+    m = _ScaleManagerFake(n=2)
+    a = Autoscaler(m, lambda: {}, min_replicas=1, max_replicas=99,
+                   hysteresis=1, cooldown_s=30.0)
+    fired = []
+    for t in range(70):
+        verdict = "add" if t % 2 == 0 else "hold"
+        if a.step(_scale_st(verdict), float(t)) is not None:
+            fired.append(t)
+    assert fired == [0, 30, 60], fired
+    assert m.calls == ["add", "add", "add"]
+    assert a.actions == 3
+
+
+def test_autoscaler_bounds_and_manager_refusal_do_not_arm_cooldown():
+    # At the bounds the verdict is refused silently: no lever pulled,
+    # no event, and — critically — no cooldown armed.
+    m = _ScaleManagerFake(n=3)
+    a = Autoscaler(m, lambda: {}, min_replicas=3, max_replicas=3,
+                   hysteresis=1, cooldown_s=1000.0)
+    assert a.step(_scale_st("add"), 0.0) is None
+    assert a.step(_scale_st("shed", bf=0.02, bs=0.01), 1.0) is None
+    assert m.calls == [] and a.actions == 0
+    # A manager-side shed refusal (None) is equally not an action: the
+    # very next sustained add fires despite the huge cooldown.
+    m2 = _ScaleManagerFake(n=2, shed_refuses=True)
+    a2 = Autoscaler(m2, lambda: {}, min_replicas=1, max_replicas=4,
+                    hysteresis=1, cooldown_s=1000.0)
+    assert a2.step(_scale_st("shed", bf=0.02, bs=0.01), 0.0) is None
+    assert m2.calls == [] and a2.actions == 0
+    act = a2.step(_scale_st("add"), 1.0)
+    assert act is not None and act["action"] == "add"
+    assert a2.actions == 1
+    # ...and a TAKEN action does arm it.
+    assert a2.step(_scale_st("add"), 2.0) is None
+
+
+def test_manager_shed_parks_and_add_revives(tmp_path):
+    """The roster levers without a fleet: ``shed_one`` parks the highest
+    ready slot (roster written as ``scale_down``, no loss charged, the
+    tick loop leaves it alone), a second shed refuses to take the last
+    replica, ``add_one`` revives the parked slot first and mints a
+    fresh one after."""
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+
+    def spawn(slot, hb):
+        return [sys.executable, "-c", "import time; time.sleep(600)"]
+
+    manager = ReplicaManager(2, spawn, run_dir)
+    try:
+        # Hand-spawn (no supervision thread: ports stay None, so no
+        # probes run to fight the manual ready flags).
+        for r in manager._replicas.values():
+            manager._spawn(r)
+            r.ready = True
+        shed = manager.shed_one(drain_wait_s=0.1)
+        assert shed == 1  # highest slot drains first
+        st = manager.stats()
+        assert st["replicas"] == 1 and st["parked"] == 1
+        assert st["ready"] == 1 and st["losses"] == 0
+        m = read_membership(run_dir)
+        assert m is not None and m.members == (0,)
+        assert m.reason == "scale_down"
+        # The tick loop must NOT resurrect (or charge) a parked slot.
+        manager._tick()
+        assert manager._replicas[1].proc is None
+        assert manager.stats()["losses"] == 0
+        # Never below one replica: the manager's own floor.
+        assert manager.shed_one(drain_wait_s=0.1) is None
+        # Revival reuses the parked slot identity...
+        assert manager.add_one() == 1
+        st = manager.stats()
+        assert st["replicas"] == 2 and st["parked"] == 0
+        assert manager._replicas[1].proc is not None
+        assert manager._replicas[1].ready is False  # must re-probe
+        # ...and only a parked-free roster mints a new slot.
+        assert manager.add_one() == 2
+        assert manager.stats()["replicas"] == 3
+        assert sorted(manager._replicas) == [0, 1, 2]
+    finally:
+        manager.stop()
+
+
+# --- ISSUE 18: version tags on the wire (unit) -------------------------------
+
+def _fake_metrics_target(text: str):
+    """A scripted GET /metrics endpoint (exposition text, keep-alive)."""
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: N802
+            pass
+
+        def do_GET(self):  # noqa: N802
+            data = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def test_scraper_stamps_version_label_from_build_info(tmp_path):
+    """Every series scraped from a target whose ``build_info`` carries a
+    real ``model_version`` gets a ``version`` label that round; the
+    router's ``n/a`` stamps nothing."""
+    from featurenet_tpu.fleet.pool import ConnectionPool
+    from featurenet_tpu.fleet.scraper import ROUTER_TARGET, MetricsScraper
+    from featurenet_tpu.obs import tsdb as _tsdb
+
+    replica_srv, replica_port = _fake_metrics_target(
+        'featurenet_build_info{model_version="ckpt@6-aaaa1111",'
+        'precision="fp32"} 1\n'
+        "featurenet_serve_queue_depth 3\n"
+    )
+    router_srv, router_port = _fake_metrics_target(
+        'featurenet_build_info{model_version="n/a",precision="n/a"} 1\n'
+        "featurenet_serve_queue_depth 1\n"
+    )
+    store = _tsdb.TimeSeriesStore.open(str(tmp_path))
+    pool = ConnectionPool()
+    try:
+        scraper = MetricsScraper(
+            store, pool,
+            lambda: {"0": replica_port, ROUTER_TARGET: router_port},
+        )
+        assert scraper.scrape_once() > 0
+        depth = {lb["replica"]: lb for m, lb in store.series()
+                 if m == "serve_queue_depth"}
+        # Series labels come back filename-sanitized ("@" -> "_"): the
+        # label is the series identity on disk.
+        assert depth["0"].get("version") == "ckpt_6-aaaa1111", depth
+        assert "version" not in depth[ROUTER_TARGET], depth
+    finally:
+        pool.close()
+        store.close()
+        replica_srv.shutdown()
+        router_srv.shutdown()
+
+
+def test_admin_reload_endpoint_contract():
+    """The HTTP shape of the hot-swap endpoint, against a stub service:
+    400 on garbage, 409 ``swap_refused`` naming the refusal kind and
+    the STILL-SERVING version, 200 with the new identity — every body
+    stamped with the replica id."""
+    from featurenet_tpu.serve.http import make_server
+
+    class _StubPredictor:
+        model_version = "old@1-aaaa1111"
+
+    class _StubService:
+        predictor = _StubPredictor()
+        replica = 7
+
+        def reload(self, checkpoint_dir):
+            if "corrupt" in checkpoint_dir:
+                raise ValueError("injected: candidate fails verify")
+            return {"ok": True, "model_version": "new@2-bbbb2222",
+                    "from_version": "old@1-aaaa1111", "swap_ms": 12.5}
+
+    srv = make_server(_StubService(), "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def post(data: bytes):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/reload", data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        status, doc = post(b"{nope")
+        assert status == 400 and doc["error"] == "bad_json"
+        status, doc = post(json.dumps({"other": 1}).encode())
+        assert status == 400 and doc["error"] == "bad_reload"
+        status, doc = post(
+            json.dumps({"checkpoint_dir": "/tmp/corrupt"}).encode()
+        )
+        assert status == 409, doc
+        assert doc["error"] == "swap_refused"
+        assert doc["kind"] == "ValueError"
+        assert doc["model_version"] == "old@1-aaaa1111"
+        assert doc["replica"] == 7
+        status, doc = post(
+            json.dumps({"checkpoint_dir": "/tmp/good"}).encode()
+        )
+        assert status == 200, doc
+        assert doc["ok"] is True
+        assert doc["model_version"] == "new@2-bbbb2222"
+        assert doc["replica"] == 7
+    finally:
+        srv.shutdown()
+
+
+def test_swap_params_flips_version_and_keeps_predictions(
+    fleet_ckpt, tmp_path
+):
+    """The live double-buffer: ``swap_params`` to a checkpoint COPY
+    flips ``model_version``/``checkpoint_dir`` (new deploy identity,
+    same content hash), predictions are bit-identical (same weights),
+    and a failed swap leaves the serving generation untouched."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.infer import Predictor
+
+    cand = str(tmp_path / "cand")
+    shutil.copytree(fleet_ckpt, cand)
+    pred = Predictor.from_checkpoint(fleet_ckpt, batch=8)
+    v1 = pred.model_version
+    assert v1.startswith(os.path.basename(fleet_ckpt) + "@")
+    grids = generate_batch(np.random.default_rng(3), 8, RES)["voxels"]
+    labels1, probs1 = pred.predict_voxels(grids)
+    v2 = pred.swap_params(cand)
+    assert pred.model_version == v2
+    assert v2 != v1 and v2.startswith("cand@")
+    # A copy is a new deploy of the same content: only the basename half
+    # of <name>@<step>-<sha8> may differ.
+    assert v2.split("@", 1)[1] == v1.split("@", 1)[1], (v1, v2)
+    assert pred.checkpoint_dir == cand  # what a rollback re-submits
+    labels2, probs2 = pred.predict_voxels(grids)
+    assert np.array_equal(np.asarray(labels1), np.asarray(labels2))
+    assert np.allclose(np.asarray(probs1), np.asarray(probs2))
+    # A swap that cannot restore raises BEFORE the flip: still v2.
+    with pytest.raises(Exception):
+        pred.swap_params(str(tmp_path / "missing"))
+    assert pred.model_version == v2
+
+
+# --- ISSUE 18: registry + trend-gate wiring ----------------------------------
+
+def test_rollout_registry_and_trend_gate_wiring(tmp_path):
+    from featurenet_tpu.obs import bench_history as _bh
+    from featurenet_tpu.obs import gates as _gates
+    from featurenet_tpu.obs.report import (
+        KNOWN_EVENT_KINDS,
+        REQUIRED_EVENT_FIELDS,
+    )
+
+    # The two new chaos sites ride the swap counter (mirrors the
+    # test_slo pin pattern; the fault-sites lint derives from SITES, so
+    # both directions are auto-covered there).
+    assert faults.SITES["swap_corrupt"] == "swap"
+    assert faults.SITES["replica_loss_rollout"] == "swap"
+    parsed = faults.parse_spec("swap_corrupt@swap=2,replica_loss_rollout")
+    assert parsed["swap_corrupt"] == ("swap", 2)
+    assert parsed["replica_loss_rollout"] is None
+    # Event kinds + required fields: the report validates what the
+    # control loop emits.
+    assert {"fleet_autoscale", "swap", "rollout_start", "rollout_step",
+            "rollout_rollback", "rollout_done"} <= KNOWN_EVENT_KINDS
+    assert REQUIRED_EVENT_FIELDS["fleet_autoscale"] == \
+        ("action", "from_n", "to_n", "reason")
+    assert REQUIRED_EVENT_FIELDS["swap"] == \
+        ("ok", "from_version", "swap_ms")
+    assert REQUIRED_EVENT_FIELDS["rollout_start"] == \
+        ("checkpoint_dir", "replicas")
+    assert REQUIRED_EVENT_FIELDS["rollout_step"] == ("replica", "ok")
+    assert REQUIRED_EVENT_FIELDS["rollout_rollback"] == \
+        ("reason", "rolled_back")
+    assert REQUIRED_EVENT_FIELDS["rollout_done"] == ("ok", "swapped")
+    # bench-history columns + gate keys + slack + directions, one row
+    # per new pin.
+    for key in ("fleet_scale_actions", "rollout_swap_ms",
+                "rollout_agreement"):
+        assert key in _gates.BENCH_GATE_KEYS
+        assert key in _gates.NOISY_KEY_ABS_SLACK
+        assert any(col == key for col, _h, _f in _bh._COLUMNS)
+    assert _gates.DIRECTIONS["fleet_scale_actions"] == "max"
+    assert _gates.DIRECTIONS["rollout_swap_ms"] == "max"
+    assert _gates.DIRECTIONS["rollout_agreement"] == "min"
+    # The trend gate actually judges them: one borderline autoscale
+    # action is legal (abs slack), a swap-wall blowout and an agreement
+    # collapse are not.
+    d = str(tmp_path)
+    with open(os.path.join(d, "BENCH_r1.json"), "w") as fh:
+        json.dump({"value": 1000.0, "fleet_scale_actions": 1.0,
+                   "rollout_swap_ms": 3000.0,
+                   "rollout_agreement": 1.0}, fh)
+    with open(os.path.join(d, "BENCH_r2.json"), "w") as fh:
+        json.dump({"value": 1000.0, "fleet_scale_actions": 2.0,
+                   "rollout_swap_ms": 5600.0,
+                   "rollout_agreement": 0.85}, fh)
+    res = _bh.trend_gate(_bh.load_rounds(d))
+    assert not res["ok"]
+    assert set(res["failed"]) == {"rollout_swap_ms",
+                                  "rollout_agreement"}, res
+
+
+# --- ISSUE 18: the chaos-gate e2es -------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_cache(tmp_path_factory):
+    """One exec cache shared by the ISSUE-18 e2es: the first fleet pays
+    the XLA compiles, every later replica (and every respawn) warms
+    from disk."""
+    return str(tmp_path_factory.mktemp("fleet_cache"))
+
+
+def _run_rollout(run_dir: str, ckpt: str, timeout_s: float = 600.0):
+    """``cli fleet rollout`` as a REAL subprocess (the orchestrator owns
+    its own obs stream; in-process it would steal the test's) + the
+    parsed one-line JSON verdict off its stdout."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "featurenet_tpu.cli", "fleet", "rollout",
+         ckpt, "--run-dir", run_dir, "--batch", "16",
+         "--converge-timeout-s", "240"],
+        capture_output=True, text=True, timeout=timeout_s,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    doc = None
+    for line in proc.stdout.splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "fleet_rollout" in d:
+            doc = d["fleet_rollout"]
+    return proc, doc
+
+
+def test_fleet_e2e_autoscale_add_on_load_ramp(
+    fleet_ckpt, fleet_cache, tmp_path
+):
+    """ISSUE 18 chaos gate (load ramp): a 2-replica CPU fleet, one
+    replica dragging (``replica_slow``: the contended-host shape), hit
+    with a 4x open-loop traffic step — the acting autoscaler turns the
+    router's sustained burn verdict into a REAL third replica, nothing
+    admitted is dropped through the ramp or the spawn, and the scaled
+    fleet holds the p99 pin under the settled rate."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.fleet.loadgen import http_load, replica_argv
+    from featurenet_tpu.obs import alerts as _alerts
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, process_index=0,
+                 extra={"cmd": "fleet-e2e-autoscale"})
+    # Replica 1 drags SLOW_SLEEP_S on every forward: the deterministic
+    # under-capacity shape (whether THIS box absorbs 4x clean is a
+    # hardware lottery; a dragging replica under a 4x step is not).
+    fault_for = {1: "replica_slow@request=1:every=1"}
+
+    def spawn(slot, hb):
+        return replica_argv(
+            fleet_ckpt, slot, hb, run_dir=run_dir,
+            exec_cache_dir=fleet_cache, buckets="1,2", max_wait_ms=3.0,
+            queue_limit=64, inject_faults=fault_for.get(slot),
+        )
+
+    # Store-less burn: the router's own serving_ms ring feeds the same
+    # burn math the tsdb path runs. The 200 ms / 95% objective sits
+    # between the fleet's clean walls (tens of ms) and the dragged
+    # forward (SLOW_SLEEP_S = 250 ms). slo_p99_ms=5000 keeps the
+    # threshold alerts (and the drain gate) out of the story.
+    rule = _alerts.BurnRateRule("serving_p99_ms", "<", 200.0, 0.95,
+                                "critical", fast_s=5.0, slow_s=45.0)
+    manager = ReplicaManager(2, spawn, run_dir)
+    router = FleetRouter(manager, slo_p99_ms=5000.0, scale_every_s=0.5,
+                         slos=[rule])
+    autoscaler = Autoscaler(manager, router.scale_state,
+                            min_replicas=2, max_replicas=3,
+                            hysteresis=2, cooldown_s=120.0,
+                            interval_s=0.25)
+    srv = None
+    try:
+        manager.start()
+        deadline = time.monotonic() + 420
+        while manager.ready_count() < 2:
+            assert time.monotonic() < deadline, \
+                f"fleet warmup timed out: {manager.stats()}"
+            time.sleep(0.25)
+        srv = router.make_server("127.0.0.1", 0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        obs.emit("fleet_start", replicas=2, host="127.0.0.1", port=port)
+        grids = generate_batch(
+            np.random.default_rng(0), 16, RES
+        )["voxels"]
+        # --- base rate: a 2-replica fleet absorbs it, zero drops ------
+        # (no-action-on-clean-verdicts discipline is unit-pinned above;
+        # the autoscaler arms at the step so the burn it acts on is the
+        # step's, not the warmup transient's)
+        stats, _ = http_load("127.0.0.1", port, qps=20.0,
+                             n_requests=60, grids=grids)
+        assert stats["dropped"] == 0, stats
+        assert manager.stats()["replicas"] == 2, manager.stats()
+        # --- the 4x step: hammer until the sustained add lands --------
+        autoscaler.start()
+        t_end = time.monotonic() + 240
+        while manager.stats()["replicas"] < 3:
+            assert time.monotonic() < t_end, (
+                router.scale_state(), autoscaler.stats())
+            stats, _ = http_load("127.0.0.1", port, qps=80.0,
+                                 n_requests=48, grids=grids)
+            assert stats["dropped"] == 0, stats
+        t_ready = time.monotonic() + 300
+        while manager.ready_count() < 3:
+            assert time.monotonic() < t_ready, \
+                f"scale-out warmup timed out: {manager.stats()}"
+            time.sleep(0.25)
+        # Exactly one action: the cooldown + max_replicas bound pin the
+        # roster through the rest of the ramp.
+        assert autoscaler.actions == 1, autoscaler.stats()
+        autoscaler.stop()  # freeze the roster for the settle asserts
+        # --- settled: the 3-replica fleet under the base rate ---------
+        stats, _ = http_load("127.0.0.1", port, qps=30.0,
+                             n_requests=120, grids=grids)
+        assert stats["dropped"] == 0, stats
+        assert stats["answered"] >= 100, stats
+        assert stats["p99_ms"] is not None and stats["p99_ms"] < 2000.0, \
+            stats
+        srv.shutdown()
+        srv = None
+        st = router.drain()
+        assert st["exit_code"] == 0, st
+        assert st["dropped"] == 0
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        autoscaler.stop()
+        manager.stop()
+        obs.close_run()
+    # --- post-hoc: the action is on the record, the replica is real --------
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    acts = [e for e in events if e["ev"] == "fleet_autoscale"]
+    assert len(acts) == 1, acts
+    assert acts[0]["action"] == "add"
+    assert (acts[0]["from_n"], acts[0]["to_n"]) == (2, 3)
+    assert acts[0]["reason"].startswith("sustained_add(")
+    readies = [e for e in events if e["ev"] == "fleet_replica_ready"]
+    assert any(e["replica"] == 2 for e in readies), readies
+    m = read_membership(run_dir)
+    assert m is not None and 2 in m.members
+    rep = build_report(events)
+    assert rep["fleet"]["autoscale_actions"] == {"add": 1}
+    assert any(e["event"] == "fleet_autoscale"
+               for e in rep["fleet"]["timeline"])
+    assert "fleet:" in format_report(rep)
+
+
+def test_fleet_e2e_rollout_canary_swap_then_corrupt_rollback(
+    fleet_ckpt, fleet_cache, tmp_path
+):
+    """ISSUE 18 acceptance (rollout): ``cli fleet rollout`` hot-swaps a
+    LIVE 2-replica fleet to a checkpoint copy one replica at a time —
+    replay-canaried against each replica's own capture ring, zero
+    admitted drops while each replica cordons and drains through the
+    router's spillover path, zero post-warmup compiles in the swapped
+    replicas, model_version threaded through /healthz and the scraped
+    store (mixed-version window observable; converged after) — then a
+    SECOND rollout whose candidate arrives checksum-corrupt on replica
+    1 rolls the already-swapped replica 0 back and exits 2,
+    re-converging the fleet on the serving generation."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.fleet.loadgen import http_load, replica_argv
+    from featurenet_tpu.fleet.scraper import ROUTER_TARGET, MetricsScraper
+    from featurenet_tpu.obs import tsdb as _tsdb
+
+    run_dir = str(tmp_path / "run")
+    cand = str(tmp_path / "cand")
+    cand3 = str(tmp_path / "cand3")
+    shutil.copytree(fleet_ckpt, cand)
+    shutil.copytree(fleet_ckpt, cand3)
+    obs.init_run(run_dir, process_index=0,
+                 extra={"cmd": "fleet-e2e-rollout"})
+    # Slot 1's SECOND reload arrives checksum-broken: rollout 1 is swap
+    # #1 everywhere (clean), so the fault fires during rollout 2 AFTER
+    # slot 0 already swapped — forcing the rollback path. Slot 0
+    # carries no spec, so its own rollback swap cannot trip.
+    fault_for = {1: "swap_corrupt@swap=2"}
+
+    def spawn(slot, hb):
+        return replica_argv(
+            fleet_ckpt, slot, hb, run_dir=run_dir,
+            exec_cache_dir=fleet_cache, buckets="1,2", max_wait_ms=3.0,
+            queue_limit=64, capture=True, capture_sample=1.0,
+            inject_faults=fault_for.get(slot),
+        )
+
+    store = _tsdb.TimeSeriesStore.open(run_dir)
+    manager = ReplicaManager(2, spawn, run_dir)
+    router = FleetRouter(manager, slo_p99_ms=5000.0,
+                         scale_every_s=3600.0)
+    srv = None
+    port = None
+
+    def _healthz():
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            return json.loads(resp.read())
+
+    try:
+        manager.start()
+        deadline = time.monotonic() + 420
+        while manager.ready_count() < 2:
+            assert time.monotonic() < deadline, \
+                f"fleet warmup timed out: {manager.stats()}"
+            time.sleep(0.25)
+        srv = router.make_server("127.0.0.1", 0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        obs.emit("fleet_start", replicas=2, host="127.0.0.1", port=port)
+        scraper = MetricsScraper(
+            store, manager.pool,
+            lambda: {
+                **{str(s): p
+                   for s, p in manager.stats()["ports"].items()},
+                ROUTER_TARGET: port,
+            },
+        )
+        grids = generate_batch(
+            np.random.default_rng(0), 16, RES
+        )["voxels"]
+        # Fill both capture rings (capture_sample=1.0 records every
+        # answered request) and scrape the v1 world into the store.
+        stats, _ = http_load("127.0.0.1", port, qps=40.0,
+                             n_requests=80, grids=grids)
+        assert stats["dropped"] == 0, stats
+        scraper.scrape_once()
+        versions0 = _healthz().get("versions") or {}
+        assert set(versions0) == {"0", "1"}, versions0
+        assert len(set(versions0.values())) == 1, versions0
+        v1 = versions0["0"]
+        assert v1.startswith(os.path.basename(fleet_ckpt) + "@"), v1
+        for slot in (0, 1):
+            ring = os.path.join(run_dir, "capture", f"replica{slot}")
+            assert os.path.isdir(ring) and os.listdir(ring), \
+                f"no capture ring for replica {slot}"
+        # --- rollout 1: rolling swap under live load, watchers on -----
+        snapshots: list = []
+        load_stats: list = []
+        stop_bg = threading.Event()
+
+        def _poll():
+            while not stop_bg.is_set():
+                try:
+                    snapshots.append(dict(
+                        _healthz().get("versions") or {}
+                    ))
+                    scraper.scrape_once()
+                except Exception:
+                    pass  # one blipped poll must not kill the watcher
+                stop_bg.wait(0.2)
+
+        def _pump():
+            while not stop_bg.is_set():
+                s, _o = http_load("127.0.0.1", port, qps=20.0,
+                                  n_requests=20, grids=grids)
+                load_stats.append(s)
+
+        watchers = [threading.Thread(target=_poll, daemon=True),
+                    threading.Thread(target=_pump, daemon=True)]
+        for t in watchers:
+            t.start()
+        proc, doc = _run_rollout(run_dir, cand)
+        stop_bg.set()
+        for t in watchers:
+            t.join(timeout=120)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert doc is not None and doc["ok"] is True, proc.stdout
+        assert doc["swapped"] == [0, 1]
+        assert doc["converged"] is True
+        v2 = doc["version"]
+        assert v2.startswith("cand@") and v2 != v1, (v1, v2)
+        # A copy is a new deploy of the same content: the step-hash half
+        # of the tag is shared, only the deploy name moved.
+        assert v2.split("@", 1)[1] == v1.split("@", 1)[1], (v1, v2)
+        steps = {s["replica"]: s for s in doc["steps"]}
+        for slot in (0, 1):
+            assert steps[slot]["canary_n"] > 0, steps
+            assert steps[slot]["agreement"] >= 0.967, steps
+            assert steps[slot]["swap_ms"] > 0, steps
+            assert steps[slot]["model_version"] == v2, steps
+        # ZERO admitted drops while each replica cordoned + drained.
+        assert load_stats, "load pump never completed a burst"
+        assert all(s["dropped"] == 0 for s in load_stats), load_stats
+        # The mixed-version window was OBSERVABLE at the router: some
+        # /healthz snapshot saw both generations side by side...
+        assert any(len(set(s.values())) == 2 for s in snapshots), \
+            snapshots
+        # ...and it CLOSED: one version everywhere now.
+        assert set((_healthz().get("versions") or {}).values()) == {v2}
+        # Post-swap traffic serves the new generation with ZERO fresh
+        # compiles in the replica processes: the AOT programs take the
+        # weights as arguments, so the flip touched no executable.
+        stats, _ = http_load("127.0.0.1", port, qps=40.0,
+                             n_requests=60, grids=grids)
+        assert stats["dropped"] == 0, stats
+        scraper.scrape_once()
+        events_mid, _bad = load_events(run_dir)
+        swaps_ok = [e for e in events_mid
+                    if e["ev"] == "swap" and e.get("ok")]
+        assert len(swaps_ok) >= 2, swaps_ok
+        t_first_swap = min(e["t"] for e in swaps_ok)
+        replica_pids = {e["pid"] for e in swaps_ok}
+        late = [e for e in events_mid
+                if e["ev"] == "program_compile"
+                and e.get("pid") in replica_pids
+                and e["t"] > t_first_swap]
+        assert not late, late
+        # One passing replay-canary verdict per replica, zero
+        # post-warmup compiles on the scoring path either.
+        rvs = [e for e in events_mid if e["ev"] == "replay_verdict"
+               and e.get("replica") is not None]
+        assert {e["replica"] for e in rvs} == {0, 1}, rvs
+        for e in rvs:
+            assert e["ok"] and e["agreement"] >= e["min_agreement"], e
+            assert e["post_warmup_compiles"] == 0, e
+        # The store carries the version label on every replica series —
+        # BOTH generations per replica (the before/after evidence) —
+        # and none on the router's own ("n/a" is not a version). Labels
+        # read back filename-sanitized ("@" -> "_").
+        seen: dict = {}
+        for _m, lb in store.series():
+            r = lb.get("replica")
+            if r is not None and lb.get("version"):
+                seen.setdefault(r, set()).add(lb["version"])
+        want = {v1.replace("@", "_"), v2.replace("@", "_")}
+        assert want <= seen.get("0", set()), seen
+        assert want <= seen.get("1", set()), seen
+        assert ROUTER_TARGET not in seen, seen
+        # --- rollout 2: candidate refused mid-roll -> rollback, exit 2
+        proc, doc = _run_rollout(run_dir, cand3)
+        assert proc.returncode == 2, (proc.stdout, proc.stderr)
+        assert doc is not None and doc["ok"] is False, proc.stdout
+        assert "swap_refused(replica=1,kind=ChecksumMismatch)" \
+            in doc["reason"], doc
+        assert doc["rolled_back"] == [0], doc
+        assert doc["rollback_failed"] == [], doc
+        assert doc["converged"] is True, doc
+        assert set((_healthz().get("versions") or {}).values()) == {v2}
+        stats, _ = http_load("127.0.0.1", port, qps=40.0,
+                             n_requests=40, grids=grids)
+        assert stats["dropped"] == 0, stats
+        srv.shutdown()
+        srv = None
+        st = router.drain()
+        assert st["exit_code"] == 0, st
+        assert st["dropped"] == 0
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        manager.stop()
+        store.close()
+        obs.close_run()
+    # --- post-hoc: the rollout arc in the stream and the report -------------
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    starts = [e for e in events if e["ev"] == "rollout_start"]
+    assert len(starts) == 2
+    assert all(e["replicas"] == [0, 1] for e in starts), starts
+    rollbacks = [e for e in events if e["ev"] == "rollout_rollback"]
+    assert len(rollbacks) == 1, rollbacks
+    assert rollbacks[0]["rolled_back"] == [0]
+    assert "swap_refused" in rollbacks[0]["reason"]
+    dones = [e for e in events if e["ev"] == "rollout_done"]
+    assert [bool(e["ok"]) for e in dones] == [True, False], dones
+    refused = [e for e in events
+               if e["ev"] == "swap" and not e.get("ok")]
+    assert len(refused) == 1, refused
+    assert "swap_corrupt" in str(refused[0].get("error")), refused
+    rep = build_report(events)
+    ro = rep["fleet"]["rollout"]
+    assert ro["rollbacks"] == 1
+    assert ro["swaps_refused"] == 1
+    assert ro["swaps_ok"] >= 4, ro  # 2 roll + 1 cand3 + 1 rollback
+    assert ro["ok"] is False  # the LAST arc on record is the refusal
+    tl = {e["event"] for e in rep["fleet"]["timeline"]}
+    assert {"swap", "rollout_start", "rollout_step",
+            "rollout_rollback", "rollout_done"} <= tl, tl
+    assert "fleet:" in format_report(rep)
+
+
+def test_fleet_e2e_replica_death_mid_rollout_rolls_back(
+    fleet_ckpt, fleet_cache, tmp_path
+):
+    """ISSUE 18 chaos gate (kill-during-rollout): a replica SIGKILLed
+    by the ``replica_loss_rollout`` fault mid-swap — the orchestrator
+    rolls the already-swapped replica back and exits 2, the manager
+    respawns the victim on its ORIGINAL argv from the shared cache, and
+    the fleet re-converges on ONE version: the old one."""
+    from featurenet_tpu.data.synthetic import generate_batch
+    from featurenet_tpu.fleet.loadgen import http_load, replica_argv
+
+    run_dir = str(tmp_path / "run")
+    candk = str(tmp_path / "candk")
+    shutil.copytree(fleet_ckpt, candk)
+    obs.init_run(run_dir, process_index=0,
+                 extra={"cmd": "fleet-e2e-kill-rollout"})
+    # Slot 1 dies on its FIRST reload — which arrives after slot 0
+    # (lower slot) already swapped, forcing the rollback. Mutable so
+    # the respawn argv comes up clean.
+    fault_for = {1: "replica_loss_rollout@swap=1"}
+
+    def spawn(slot, hb):
+        return replica_argv(
+            fleet_ckpt, slot, hb, run_dir=run_dir,
+            exec_cache_dir=fleet_cache, buckets="1,2", max_wait_ms=3.0,
+            queue_limit=64, inject_faults=fault_for.get(slot),
+        )
+
+    manager = ReplicaManager(2, spawn, run_dir)
+    router = FleetRouter(manager, slo_p99_ms=5000.0,
+                         scale_every_s=3600.0)
+    srv = None
+    try:
+        manager.start()
+        deadline = time.monotonic() + 420
+        while manager.ready_count() < 2:
+            assert time.monotonic() < deadline, \
+                f"fleet warmup timed out: {manager.stats()}"
+            time.sleep(0.25)
+        srv = router.make_server("127.0.0.1", 0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        obs.emit("fleet_start", replicas=2, host="127.0.0.1", port=port)
+        versions = dict(manager.stats()["versions"])
+        assert set(versions) == {0, 1}, versions
+        old = versions[0]
+        assert old.startswith(os.path.basename(fleet_ckpt) + "@")
+        # The RUNNING replicas have the fault armed (it rode their
+        # argv); clearing it now means the respawn comes up clean.
+        del fault_for[1]
+        proc, doc = _run_rollout(run_dir, candk)
+        assert proc.returncode == 2, (proc.stdout, proc.stderr)
+        assert doc is not None and doc["ok"] is False, proc.stdout
+        assert doc["reason"] == "replica_lost(replica=1)", doc
+        assert doc["rolled_back"] == [0], doc
+        assert doc["converged"] is True, doc
+        # The victim rejoins (old argv, shared cache) and the fleet
+        # settles on ONE version — the old one, everywhere.
+        t_rejoin = time.monotonic() + 300
+        while manager.ready_count() < 2:
+            assert time.monotonic() < t_rejoin, \
+                f"rejoin timed out: {manager.stats()}"
+            time.sleep(0.25)
+        ms = manager.stats()
+        assert ms["losses"] >= 1 and ms["rejoins"] >= 1, ms
+        assert set(ms["versions"].values()) == {old}, ms
+        grids = generate_batch(
+            np.random.default_rng(1), 16, RES
+        )["voxels"]
+        stats, _ = http_load("127.0.0.1", port, qps=40.0,
+                             n_requests=60, grids=grids)
+        assert stats["dropped"] == 0, stats
+        srv.shutdown()
+        srv = None
+        st = router.drain()
+        assert st["exit_code"] == 0, st
+        assert st["dropped"] == 0
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        manager.stop()
+        obs.close_run()
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    bad_steps = [e for e in events if e["ev"] == "rollout_step"
+                 and not e.get("ok")]
+    assert len(bad_steps) == 1 and bad_steps[0]["replica"] == 1, \
+        bad_steps
+    assert str(bad_steps[0].get("reason", "")).startswith(
+        "replica_lost"
+    )
+    assert [e for e in events if e["ev"] == "fleet_replica_loss"
+            and e.get("replica") == 1]
+    rollbacks = [e for e in events if e["ev"] == "rollout_rollback"]
+    assert len(rollbacks) == 1
+    assert rollbacks[0]["rolled_back"] == [0]
+    assert rollbacks[0]["reason"] == "replica_lost(replica=1)"
+    dones = [e for e in events if e["ev"] == "rollout_done"]
+    assert len(dones) == 1 and dones[0]["ok"] is False
+    rep = build_report(events)
+    assert rep["fleet"]["rollout"]["rollbacks"] == 1
+    assert rep["fleet"]["rollout"]["ok"] is False
