@@ -38,9 +38,26 @@ Rules (each a pure function over the parsed tree; see ``rules.py``):
                     channels — everything else checks one out of the pool);
                     suppress a deliberate one-shot with
                     ``# lint: allow-raw-conn(<reason>)``.
+- ``concurrency`` — thread-safety as a checked contract (``concurrency.py``):
+                    shared ``self._x`` attributes written from multiple
+                    methods across threads must hold the class's lock
+                    (``allow-unlocked``); ``Condition.wait()`` belongs under
+                    ``while``, not ``if`` (``allow-condvar-if``); nested
+                    ``with lock:`` acquisition edges are collected
+                    package-wide and any cycle is a deadlock finding
+                    (``allow-lock-order``); a thread a class starts but no
+                    stop/drain/close path joins is a leak
+                    (``allow-thread-leak``).
+- ``suppressions``— the audit of the escapes themselves: a
+                    ``# lint: allow-<key>(reason)`` comment whose rule
+                    consumed no finding there is a stale escape and is
+                    itself a finding, as is an unknown key. Only judged
+                    for families selected this run, so ``--rule telemetry``
+                    cannot flag another family's live suppressions.
 
-Surfaced as ``python -m featurenet_tpu.cli lint [--json] [--rule NAME]``
-(exit 2 on findings) and run self-clean inside tier-1
+Surfaced as ``python -m featurenet_tpu.cli lint [--format text|json|sarif]
+[--changed] [--rule NAME]`` (exit 2 on findings) and run self-clean inside
+tier-1
 (``tests/test_analysis.py``), so deleting a ``maybe_fail`` call site or an
 emit field breaks the build, not the next chaos run. Everything here is
 stdlib + ``ast`` only — the linter must run where no backend exists (CI
@@ -51,6 +68,7 @@ from featurenet_tpu.analysis.lint import (
     Finding,
     RULE_NAMES,
     format_findings,
+    format_sarif,
     package_root,
     run_lint,
 )
@@ -64,6 +82,7 @@ __all__ = [
     "Finding",
     "RULE_NAMES",
     "format_findings",
+    "format_sarif",
     "package_root",
     "run_lint",
 ]

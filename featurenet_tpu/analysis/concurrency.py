@@ -1,0 +1,605 @@
+"""Concurrency contract rules: lock discipline, deadlock ordering, and
+thread lifecycle across the serving fleet.
+
+The fleet arc turned the package thread-dense — batcher dispatcher,
+autoscaler control thread, scraper daemon, checkpoint writer, heartbeat
+monitors, router scale loop, probe threads — and fourteen modules now
+construct their own ``threading.Lock``/``Condition``, each guarding
+ad-hoc invariants that nothing checked. This module makes thread-safety
+a statically-checked contract, four checks in one ``concurrency`` rule
+family:
+
+- **unlocked_write** — in any class that constructs a lock, a ``self._x``
+  attribute written from ≥2 methods, at least one of which runs on a
+  spawned thread (``threading.Thread(target=self...)`` targets, their
+  in-class call closure, and the ``KNOWN_THREAD_ENTRY`` table of methods
+  other components call from their own threads), must be written inside
+  ``with <lock>:``. Deliberate single-writer sites carry
+  ``# lint: allow-unlocked(<reason>)``. Methods named ``*_locked`` are
+  the package's call-with-lock-held convention and count as locked.
+- **condvar_wait_if** — a ``Condition.wait()`` whose innermost enclosing
+  branch is an ``if`` instead of a ``while`` predicate loop misses the
+  spurious-wakeup re-check; suppress with
+  ``# lint: allow-condvar-if(<reason>)``. ``wait_for`` (which loops
+  internally) and ``Event.wait`` (level-triggered) are exempt — the
+  receiver must be condvar-like (assigned from ``threading.Condition``).
+- **lock_order_cycle** — nested ``with lockA: ... with lockB:``
+  acquisition edges are collected package-wide into a directed graph;
+  any cycle is a potential deadlock, reported with file:line per edge.
+  Lock identity is ``module:Class.attr`` for ``self`` locks and
+  ``module:name`` for module-level locks. Suppress an edge with
+  ``# lint: allow-lock-order(<reason>)`` on the inner acquisition line.
+- **thread_leak** — a ``threading.Thread`` constructed in a class whose
+  methods never ``.join()`` it has no shutdown contract: stop/drain/
+  close would strand the thread. Stored threads (``self._t = Thread``)
+  need a ``self._t.join(...)`` somewhere in the class; an unstored
+  fire-and-forget construction needs a same-function ``join`` or a
+  reasoned ``# lint: allow-thread-leak(<reason>)`` (e.g. the replica
+  manager's bounded, self-terminating probe threads).
+
+Everything is syntactic (stdlib ``ast``, no imports of the linted code),
+like the rest of the analysis package: the linter must run where no
+backend exists.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from featurenet_tpu.analysis.lint import Finding, Module, Tree, register
+
+# threading factory callables that produce a mutex-like object a `with`
+# block can hold. Condition is included: `with self._cv:` holds the
+# underlying lock, and the batcher/prefetcher guard state with it.
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+# Methods OTHER components invoke from their own threads — the spawned-
+# thread entry points the in-class `threading.Thread(target=self...)`
+# scan cannot see because the spawn happens elsewhere. One entry per
+# (module relpath, class): the HTTP server's handler threads call the
+# batcher's submit and the router's route; the autoscaler thread drives
+# the replica manager's roster levers; every telemetry object is written
+# from whatever thread held the sample. Growing a new cross-thread
+# surface means growing this table — which is the point: the table IS
+# the documented threading contract (see README "Static analysis").
+KNOWN_THREAD_ENTRY: dict[tuple[str, str], tuple[str, ...]] = {
+    # HTTP handler threads (ThreadingHTTPServer) admit requests and read
+    # stats; the process main thread drains.
+    ("serve/batcher.py", "ContinuousBatcher"): (
+        "submit", "stats", "drain",
+    ),
+    # /admin/reload arrives on a handler thread while the dispatcher
+    # serves; /healthz readers race the swap.
+    ("serve/service.py", "InferenceService"): (
+        "reload", "ready", "reloading", "stats", "drain",
+    ),
+    # The batcher's dispatcher thread offers every answered request.
+    ("serve/recorder.py", "FlightRecorder"): (
+        "maybe_capture", "stats", "close",
+    ),
+    # Router handler threads + the autoscaler thread drive the roster.
+    ("fleet/replica.py", "ReplicaManager"): (
+        "candidates", "note_inflight", "note_failure", "kill_one",
+        "add_one", "shed_one", "ready_count", "stats",
+    ),
+    # Handler threads route; the manager thread reads scale state.
+    ("fleet/router.py", "FleetRouter"): (
+        "route", "scale_state", "stats", "drain",
+    ),
+    # Router request threads and manager probe threads share channels.
+    ("fleet/pool.py", "ConnectionPool"): (
+        "checkout", "checkin", "retire", "retire_endpoint", "post",
+        "get", "close", "stats",
+    ),
+    # The manager pauses/stops the scrape loop from its own thread.
+    ("fleet/scraper.py", "MetricsScraper"): (
+        "pause", "stop", "stats",
+    ),
+    # Every instrumented thread feeds samples; /metrics snapshots.
+    ("obs/windows.py", "WindowAggregator"): (
+        "observe", "flush", "active_alerts", "snapshot", "samples",
+    ),
+    # Any thread may emit; close races the last emit.
+    ("obs/events.py", "EventSink"): ("emit", "close"),
+    # Dispatcher thread observes; /metrics reads stats.
+    ("obs/quality.py", "QualityTracker"): ("observe", "stats"),
+    # Scraper thread appends; the manager closes and queries.
+    ("obs/tsdb.py", "TimeSeriesStore"): ("append", "close", "stats"),
+}
+
+
+# --- shared AST helpers ------------------------------------------------------
+
+def _threading_call(node: ast.AST, names: tuple[str, ...]) -> Optional[str]:
+    """The factory name when ``node`` is ``threading.X(...)`` or a bare
+    ``X(...)`` for ``X`` in ``names``; None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "threading" and f.attr in names):
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in names:
+        return f.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """The attribute name when ``node`` is ``self.<attr>``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _assign_pairs(node: ast.AST):
+    """(target, value) pairs of an Assign/AnnAssign, tuple targets
+    unpacked positionally (``a, self.x = b, None`` pairs ``self.x``
+    with ``None``)."""
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)) and \
+                    isinstance(node.value, (ast.Tuple, ast.List)) and \
+                    len(tgt.elts) == len(node.value.elts):
+                yield from zip(tgt.elts, node.value.elts)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    yield el, None
+            else:
+                yield tgt, node.value
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        yield node.target, node.value
+
+
+def _write_targets(node: ast.AST):
+    """Attribute nodes a statement writes: Assign (incl. tuple unpack),
+    AugAssign, AnnAssign."""
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                yield from tgt.elts
+            else:
+                yield tgt
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        yield node.target
+
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _ClassScan:
+    """Everything the concurrency checks need to know about one class:
+    its lock attributes, its thread attributes, which methods run on a
+    spawned thread, and every ``self.<attr>`` write with its lock
+    context."""
+
+    def __init__(self, mod: Module, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods: dict[str, ast.AST] = {
+            n.name: n for n in node.body if isinstance(n, _FuncDef)
+        }
+        # lock attr -> (factory kind, lineno of construction)
+        self.locks: dict[str, tuple[str, int]] = {}
+        # thread attr -> lineno of the Thread construction
+        self.thread_attrs: dict[str, int] = {}
+        # unstored Thread constructions: (lineno, enclosing method name)
+        self.loose_threads: list[tuple[int, str]] = []
+        # attrs `.join()`ed anywhere in the class (self.X.join(...))
+        self.joined_attrs: set[str] = set()
+        # methods that launch threads and the method names they target
+        self.thread_targets: set[str] = set()
+        for mname, fn in self.methods.items():
+            # Locals snapshotting a self attr (`t = self._thread`): the
+            # race-free join idiom reads the attr once and joins the
+            # local — `t.join()` discharges `self._thread`.
+            alias_of: dict[str, str] = {}
+            for sub in ast.walk(fn):
+                for tgt, val in _assign_pairs(sub):
+                    if isinstance(tgt, ast.Name):
+                        src = _self_attr(val) if val is not None else None
+                        if src is not None:
+                            alias_of[tgt.id] = src
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    kind = _threading_call(val, _LOCK_FACTORIES)
+                    if kind is not None:
+                        self.locks[attr] = (kind, val.lineno)
+                    if _threading_call(val, ("Thread",)) is not None:
+                        self.thread_attrs[attr] = val.lineno
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "join"):
+                    attr = _self_attr(sub.func.value)
+                    if attr is None and isinstance(sub.func.value,
+                                                   ast.Name):
+                        attr = alias_of.get(sub.func.value.id)
+                    if attr is not None:
+                        self.joined_attrs.add(attr)
+                if _threading_call(sub, ("Thread",)) is not None:
+                    for kw in (sub.keywords
+                               if isinstance(sub, ast.Call) else ()):
+                        if kw.arg == "target":
+                            tattr = _self_attr(kw.value)
+                            if tattr is not None:
+                                self.thread_targets.add(tattr)
+        self.thread_methods = self._thread_closure()
+
+    def _thread_closure(self) -> set[str]:
+        """Methods that (may) run on a spawned thread: the in-class
+        ``Thread(target=self.X)`` targets plus the KNOWN_THREAD_ENTRY
+        rows for this class, closed over in-class ``self.Y()`` calls."""
+        entry = set(self.thread_targets)
+        entry.update(KNOWN_THREAD_ENTRY.get(
+            (self.mod.relpath, self.name), ()
+        ))
+        seen: set[str] = set()
+        frontier = [m for m in entry if m in self.methods]
+        while frontier:
+            mname = frontier.pop()
+            if mname in seen:
+                continue
+            seen.add(mname)
+            for sub in ast.walk(self.methods[mname]):
+                if isinstance(sub, ast.Call):
+                    callee = _self_attr(sub.func)
+                    if callee in self.methods and callee not in seen:
+                        frontier.append(callee)
+        return seen
+
+    def writes(self):
+        """Every ``self.<attr>`` write outside ``__init__``:
+        (attr, method name, lineno, locked) — ``locked`` is True when
+        the write sits inside ``with self.<lock>:`` for one of this
+        class's lock attrs, or in a ``*_locked`` method (the package's
+        call-with-lock-held convention)."""
+        out: list[tuple[str, str, int, bool]] = []
+
+        def visit(node: ast.AST, method: str, locked: bool):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquires = any(
+                    _self_attr(item.context_expr) in self.locks
+                    for item in node.items
+                )
+                for child in node.body:
+                    visit(child, method, locked or acquires)
+                return
+            for tgt in _write_targets(node):
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    out.append((attr, method, node.lineno, locked))
+            for child in ast.iter_child_nodes(node):
+                visit(child, method, locked)
+
+        for mname, fn in self.methods.items():
+            if mname == "__init__":
+                continue
+            held = mname.endswith("_locked")
+            for stmt in fn.body:
+                visit(stmt, mname, held)
+        return out
+
+
+def _class_scans(tree: Tree):
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield _ClassScan(mod, node)
+
+
+# --- check (a): lock discipline ----------------------------------------------
+
+def _unlocked_writes(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    for scan in _class_scans(tree):
+        if not scan.locks:
+            continue  # lock-less classes guard nothing; out of contract
+        writers: dict[str, set[str]] = {}
+        for attr, method, _, _ in scan.writes():
+            writers.setdefault(attr, set()).add(method)
+        guarded = {
+            attr for attr, methods in writers.items()
+            if len(methods) >= 2 and methods & scan.thread_methods
+            and attr not in scan.locks and attr not in scan.thread_attrs
+        }
+        for attr, method, lineno, locked in scan.writes():
+            if attr not in guarded or locked:
+                continue
+            if scan.mod.suppressed(lineno, "unlocked"):
+                continue
+            findings.append(Finding(
+                "concurrency", "unlocked_write", scan.mod.path, lineno,
+                f"{scan.name}.{attr} is written from "
+                f"{len(writers[attr])} methods "
+                f"({', '.join(sorted(writers[attr]))}) including a "
+                f"spawned-thread path, but this write in {method}() "
+                f"holds none of the class's locks "
+                f"({', '.join(sorted(scan.locks))}) — wrap it in "
+                "`with <lock>:` or annotate "
+                "# lint: allow-unlocked(<why single-writer>)",
+            ))
+    return findings
+
+
+# --- check (b): condvar wait under `if` --------------------------------------
+
+def _condvar_idents(mod: Module) -> set[str]:
+    """Identifiers bound to a ``threading.Condition`` anywhere in the
+    module: ``self.X`` attrs and bare names (module or function scope).
+    Name-keyed module-wide — a rename collision across classes is
+    conceivable but only widens the check to more ``.wait()`` sites."""
+    idents: set[str] = set()
+    for node in ast.walk(mod.tree):
+        for tgt, val in _assign_pairs(node):
+            if _threading_call(val, ("Condition",)) is None:
+                continue
+            attr = _self_attr(tgt)
+            if attr is not None:
+                idents.add(f"self.{attr}")
+            elif isinstance(tgt, ast.Name):
+                idents.add(tgt.id)
+    return idents
+
+
+def _render_receiver(node: ast.AST) -> Optional[str]:
+    attr = _self_attr(node)
+    if attr is not None:
+        return f"self.{attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _condvar_wait_if(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in tree.modules:
+        condvars = _condvar_idents(mod)
+        if not condvars:
+            continue
+
+        def visit(node: ast.AST, branch_stack: list[str]):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"
+                    and _render_receiver(node.func.value) in condvars):
+                innermost = branch_stack[-1] if branch_stack else None
+                if innermost == "if" and not mod.suppressed(
+                        node.lineno, "condvar-if"):
+                    findings.append(Finding(
+                        "concurrency", "condvar_wait_if", mod.path,
+                        node.lineno,
+                        f"{_render_receiver(node.func.value)}.wait() is "
+                        "guarded by `if`, not a `while` predicate loop — "
+                        "a spurious or stolen wakeup proceeds without "
+                        "the condition holding; re-check in a while "
+                        "(or annotate # lint: allow-condvar-if(<why>))",
+                    ))
+            pushed = None
+            if isinstance(node, ast.While):
+                pushed = "while"
+            elif isinstance(node, ast.If):
+                pushed = "if"
+            if pushed:
+                branch_stack.append(pushed)
+            for child in ast.iter_child_nodes(node):
+                visit(child, branch_stack)
+            if pushed:
+                branch_stack.pop()
+
+        visit(mod.tree, [])
+    return findings
+
+
+# --- check (c): lock-order graph ---------------------------------------------
+
+def _module_locks(mod: Module) -> dict[str, str]:
+    """Module-level lock names -> factory kind."""
+    out: dict[str, str] = {}
+    for node in mod.tree.body:
+        for tgt, val in _assign_pairs(node):
+            kind = _threading_call(val, _LOCK_FACTORIES)
+            if kind is not None and isinstance(tgt, ast.Name):
+                out[tgt.id] = kind
+    return out
+
+
+def _lock_order_edges(tree: Tree):
+    """Directed acquisition edges (outer_id, inner_id, mod, lineno) from
+    syntactically nested ``with`` blocks, plus each lock's factory kind.
+    Lock ids: ``relpath:Class.attr`` for self locks, ``relpath:name``
+    for module-level locks."""
+    edges: list[tuple[str, str, Module, int]] = []
+    kinds: dict[str, str] = {}
+    for mod in tree.modules:
+        mod_locks = _module_locks(mod)
+        for name, kind in mod_locks.items():
+            kinds[f"{mod.relpath}:{name}"] = kind
+
+        def lock_id(expr: ast.AST, cls: Optional[_ClassScan]
+                    ) -> Optional[str]:
+            attr = _self_attr(expr)
+            if attr is not None and cls is not None and attr in cls.locks:
+                return f"{mod.relpath}:{cls.name}.{attr}"
+            if isinstance(expr, ast.Name) and expr.id in mod_locks:
+                return f"{mod.relpath}:{expr.id}"
+            return None
+
+        def visit(node: ast.AST, held: list[str],
+                  cls: Optional[_ClassScan]):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired: list[str] = []
+                for item in node.items:
+                    lid = lock_id(item.context_expr, cls)
+                    if lid is None:
+                        continue
+                    for outer in held + acquired:
+                        edges.append((outer, lid, mod, node.lineno))
+                    acquired.append(lid)
+                for child in node.body:
+                    visit(child, held + acquired, cls)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, cls)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                scan = _ClassScan(mod, node)
+                for kname, (kind, _) in scan.locks.items():
+                    kinds[f"{mod.relpath}:{scan.name}.{kname}"] = kind
+                for fn in scan.methods.values():
+                    for stmt in fn.body:
+                        visit(stmt, [], scan)
+        # Module-level / free-function nesting (outside any class).
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                visit(node, [], None)
+    return edges, kinds
+
+
+def _find_cycles(graph: dict[str, set[str]]) -> list[list[str]]:
+    """Simple cycles via DFS back-edge reconstruction; each cycle is
+    canonicalized (rotated to its minimum node) and reported once."""
+    cycles: list[list[str]] = []
+    seen_keys: set[tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: list[str], on_stack: set[str],
+            visited: set[str]):
+        visited.add(node)
+        stack.append(node)
+        on_stack.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):]
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                if canon not in seen_keys:
+                    seen_keys.add(canon)
+                    cycles.append(list(canon))
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack, visited)
+        stack.pop()
+        on_stack.discard(node)
+
+    visited: set[str] = set()
+    for node in sorted(graph):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+    return cycles
+
+
+def _lock_order_cycles(tree: Tree) -> list[Finding]:
+    edges, kinds = _lock_order_edges(tree)
+    graph: dict[str, set[str]] = {}
+    for outer, inner, _, _ in edges:
+        if outer == inner and kinds.get(outer) == "RLock":
+            continue  # re-entrant self-acquisition is the RLock contract
+        graph.setdefault(outer, set()).add(inner)
+    findings: list[Finding] = []
+    for cycle in _find_cycles(graph):
+        pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+        sites: list[tuple[Module, int]] = []
+        for a, b in pairs:
+            for outer, inner, mod, lineno in edges:
+                if (outer, inner) == (a, b):
+                    sites.append((mod, lineno))
+                    break
+        if any(mod.suppressed(lineno, "lock-order")
+               for mod, lineno in sites):
+            continue
+        edge_txt = "; ".join(
+            f"{a} -> {b} at {mod.relpath}:{lineno}"
+            for (a, b), (mod, lineno) in zip(pairs, sites)
+        )
+        anchor_mod, anchor_line = sites[0]
+        findings.append(Finding(
+            "concurrency", "lock_order_cycle", anchor_mod.path,
+            anchor_line,
+            f"lock acquisition cycle {' -> '.join(cycle + [cycle[0]])} "
+            f"— potential deadlock; edges: {edge_txt}. Break the cycle "
+            "or annotate an edge with # lint: allow-lock-order(<why>)",
+        ))
+    return findings
+
+
+# --- check (d): thread lifecycle ---------------------------------------------
+
+def _thread_leaks(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    for scan in _class_scans(tree):
+        for attr, lineno in sorted(scan.thread_attrs.items()):
+            if attr in scan.joined_attrs:
+                continue
+            if scan.mod.suppressed(lineno, "thread-leak"):
+                continue
+            findings.append(Finding(
+                "concurrency", "thread_leak", scan.mod.path, lineno,
+                f"{scan.name}.{attr} is a threading.Thread no method of "
+                f"{scan.name} ever joins — the stop/drain/close path "
+                "strands it; join it on shutdown or annotate "
+                "# lint: allow-thread-leak(<why unjoined is safe>)",
+            ))
+        # Unstored constructions: Thread(...) not assigned to self.<attr>
+        # and whose local name (if any) is never joined in the same
+        # method — fire-and-forget with no shutdown contract.
+        for mname, fn in scan.methods.items():
+            stored_lines = {
+                val.lineno
+                for sub in ast.walk(fn)
+                for tgt, val in _assign_pairs(sub)
+                if _self_attr(tgt) is not None
+                and _threading_call(val, ("Thread",)) is not None
+            }
+            local_joined: set[str] = set()
+            local_threads: dict[str, int] = {}
+            anonymous: list[int] = []
+            for sub in ast.walk(fn):
+                for tgt, val in _assign_pairs(sub):
+                    if (_threading_call(val, ("Thread",)) is not None
+                            and isinstance(tgt, ast.Name)):
+                        local_threads[tgt.id] = val.lineno
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "join"
+                        and isinstance(sub.func.value, ast.Name)):
+                    local_joined.add(sub.func.value.id)
+                if (_threading_call(sub, ("Thread",)) is not None
+                        and sub.lineno not in stored_lines):
+                    anonymous.append(sub.lineno)
+            anonymous = [
+                ln for ln in anonymous
+                if ln not in local_threads.values()
+            ]
+            for name, ln in sorted(local_threads.items()):
+                if name not in local_joined:
+                    anonymous.append(ln)
+            for ln in sorted(set(anonymous)):
+                if scan.mod.suppressed(ln, "thread-leak"):
+                    continue
+                findings.append(Finding(
+                    "concurrency", "thread_leak", scan.mod.path, ln,
+                    f"fire-and-forget threading.Thread in "
+                    f"{scan.name}.{mname}() is neither stored on self "
+                    "nor joined in this method — no shutdown path can "
+                    "wait it out; store/join it or annotate "
+                    "# lint: allow-thread-leak(<why unjoined is safe>)",
+                ))
+    return findings
+
+
+# --- the rule family ---------------------------------------------------------
+
+@register("concurrency")
+def concurrency_rule(tree: Tree) -> list[Finding]:
+    """Lock discipline, condvar predicates, lock-order cycles, and
+    thread lifecycle — the serving fleet's threading contract (see the
+    module docstring for each check's exact shape)."""
+    findings: list[Finding] = []
+    findings.extend(_unlocked_writes(tree))
+    findings.extend(_condvar_wait_if(tree))
+    findings.extend(_lock_order_cycles(tree))
+    findings.extend(_thread_leaks(tree))
+    return findings

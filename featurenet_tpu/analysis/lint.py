@@ -21,9 +21,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import os
 import re
+import tokenize
 from typing import Callable, Iterable, Optional
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow-([a-z0-9-]+)\(([^)]+)\)")
@@ -53,11 +55,34 @@ class Module:
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
-        # line number -> set of allow-keys on that line.
+        # line number -> set of allow-keys on that line. Extracted from
+        # COMMENT tokens, not raw lines: the suppression-audit rule
+        # would otherwise read every docstring that *mentions* the
+        # ``# lint: allow-...`` syntax (this package documents it
+        # everywhere) as a stale escape. Anchored to the token start for
+        # the same reason — a block comment *quoting* the syntax is
+        # documentation, only a comment that IS the directive counts.
         self.suppressions: dict[int, set[str]] = {}
-        for i, line in enumerate(self.lines, start=1):
-            for m in _SUPPRESS_RE.finditer(line):
-                self.suppressions.setdefault(i, set()).add(m.group(1))
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.match(tok.string)
+                if m:
+                    self.suppressions.setdefault(
+                        tok.start[0], set()
+                    ).add(m.group(1))
+        except (tokenize.TokenError, IndentationError):
+            # The source already parsed (ast above), so this is near-
+            # unreachable; degrade to the raw-line scan rather than
+            # silently dropping every suppression in the file.
+            for i, line in enumerate(self.lines, start=1):
+                for m in _SUPPRESS_RE.finditer(line):
+                    self.suppressions.setdefault(i, set()).add(m.group(1))
+        # (comment line, key) pairs a rule actually consumed this run —
+        # the suppression-audit rule flags the rest as stale escapes.
+        self.used_suppressions: set[tuple[int, str]] = set()
 
     def suppressed(self, lineno: int, key: str) -> bool:
         """True when ``lineno`` (or a comment-only line directly above it)
@@ -65,11 +90,14 @@ class Module:
         long statements readable; it must be a pure comment line so the
         suppression can't accidentally cover two statements."""
         if key in self.suppressions.get(lineno, ()):
+            self.used_suppressions.add((lineno, key))
             return True
         above = lineno - 1
         if key in self.suppressions.get(above, ()):
             text = self.lines[above - 1].strip() if above >= 1 else ""
-            return text.startswith("#")
+            if text.startswith("#"):
+                self.used_suppressions.add((above, key))
+                return True
         return False
 
 
@@ -79,6 +107,11 @@ class Tree:
     def __init__(self, root: str, modules: list[Module]):
         self.root = root
         self.modules = modules
+        # Rule families selected for this run (set by run_lint) — the
+        # suppression audit only judges keys whose owning family ran,
+        # so `--rule telemetry` can't spray false unused-suppression
+        # findings for rules that never had the chance to consume them.
+        self.selected: list[str] = list(RULE_NAMES)
 
     def module(self, relpath: str) -> Optional[Module]:
         for m in self.modules:
@@ -144,8 +177,42 @@ def _is_under(path: str, root: str) -> bool:
         return False
 
 
+def _git_changed_files(root: str) -> Optional[set[str]]:
+    """Absolute paths of files the working tree changed vs HEAD
+    (tracked modifications plus untracked files), or None when git is
+    absent / ``root`` is not inside a work tree — the caller falls back
+    to the full-package lint, never a silently-empty one."""
+    import subprocess
+
+    def _git(*args: str) -> Optional[list[str]]:
+        try:
+            proc = subprocess.run(
+                ["git", "-C", root, *args],
+                capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if proc.returncode != 0:
+            return None
+        return proc.stdout.splitlines()
+
+    top = _git("rev-parse", "--show-toplevel")
+    if not top:
+        return None
+    changed = _git("diff", "--name-only", "HEAD")
+    untracked = _git("ls-files", "--others", "--exclude-standard")
+    if changed is None or untracked is None:
+        return None
+    return {
+        os.path.abspath(os.path.join(top[0], name))
+        for name in changed + untracked
+        if name.strip()
+    }
+
+
 def run_lint(root: Optional[str] = None,
-             rules: Optional[Iterable[str]] = None) -> list[Finding]:
+             rules: Optional[Iterable[str]] = None,
+             changed_only: bool = False) -> list[Finding]:
     """Lint ``root`` (default: the installed package) with the named rules
     (default: all). Findings come back path/line-sorted, suppressions
     already honored.
@@ -183,6 +250,13 @@ def run_lint(root: Optional[str] = None,
         raise ValueError(
             f"unknown lint rule(s) {unknown}; have {sorted(RULES)}"
         )
+    # The suppression audit judges which escapes the OTHER selected
+    # rules consumed, so it must run after all of them regardless of
+    # the order the caller named the families in.
+    if "suppressions" in selected:
+        selected = [r for r in selected if r != "suppressions"]
+        selected.append("suppressions")
+    tree.selected = list(selected)
     findings: list[Finding] = []
     for name in selected:
         findings.extend(RULES[name](tree))
@@ -191,6 +265,14 @@ def run_lint(root: Optional[str] = None,
             f for f in findings
             if f.line == 0 or f.path == scope or _is_under(f.path, scope)
         ]
+    if changed_only:
+        changed = _git_changed_files(tree.root)
+        if changed is not None:
+            # Package-level (line 0) findings survive: a dead fault site
+            # is real no matter which files the diff touched.
+            findings = [
+                f for f in findings if f.line == 0 or f.path in changed
+            ]
     findings.sort(key=lambda f: (f.path, f.line, f.check))
     return findings
 
@@ -213,3 +295,43 @@ def format_findings(findings: list[Finding], as_json: bool = False) -> str:
     ]
     lines.append(f"lint: {len(findings)} finding(s)")
     return "\n".join(lines)
+
+
+def format_sarif(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 rendering (``cli lint --format sarif``) so CI systems
+    that speak SARIF (code-scanning uploads, inline PR annotations) can
+    consume findings with no adapter. One run, one result per finding;
+    rule ids are ``family/check``. Package-level findings (line 0) carry
+    no region — SARIF regions are 1-indexed."""
+    rule_ids = sorted({f"{f.rule}/{f.check}" for f in findings})
+    results = []
+    for f in findings:
+        loc: dict = {
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": f.path.replace(os.sep, "/"),
+                },
+            },
+        }
+        if f.line:
+            loc["physicalLocation"]["region"] = {"startLine": f.line}
+        results.append({
+            "ruleId": f"{f.rule}/{f.check}",
+            "level": "error",
+            "message": {"text": f.msg},
+            "locations": [loc],
+        })
+    log = {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "featurenet-lint",
+                    "rules": [{"id": rid} for rid in rule_ids],
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(log, indent=2, sort_keys=True)

@@ -303,7 +303,16 @@ HOT_PATH_MODULES = ("train/loop.py", "train/steps.py", "infer.py",
                     # pool with the router's forwards — a host sync (or
                     # any device coupling) in its loop would stall the
                     # data plane it is only supposed to observe.
-                    "fleet/scraper.py")
+                    "fleet/scraper.py",
+                    # The observability plane (tsdb writer, quality
+                    # tracker, flight recorder) runs inside the serve
+                    # request path and the scrape loop — both
+                    # latency-budgeted. The recorder in particular
+                    # handles device arrays (it snapshots request
+                    # grids), so a readback there would be paid inline
+                    # by the request it is recording.
+                    "obs/tsdb.py", "obs/quality.py",
+                    "serve/recorder.py")
 
 
 def _is_host_sync(node: ast.Call) -> Optional[str]:
@@ -960,5 +969,71 @@ def alert_docs_rule(tree: Tree) -> list[Finding]:
                         line,
                         f"alert-rule example {m.group(0)!r} uses severity "
                         f"{severity!r}; one of {', '.join(SEVERITIES)}",
+                    ))
+    return findings
+
+
+# --- rule 9: concurrency (lock discipline / deadlock / thread lifecycle) ------
+
+# The four concurrency checks live in their own module (they carry real
+# per-class dataflow machinery); importing it here registers the family
+# in the same registry, in declaration order.
+from featurenet_tpu.analysis import concurrency as _concurrency  # noqa: E402,F401
+
+
+# --- rule 10: unused-suppression audit ---------------------------------------
+
+# Which rule family owns each `# lint: allow-<key>(reason)` escape. The
+# audit only judges a key when its owning family actually ran (see
+# Tree.selected): under `--rule telemetry` a host-sync suppression never
+# had the chance to be consumed and must not read as stale.
+SUPPRESSION_FAMILIES = {
+    "host-sync": "host-sync",
+    "wall-clock": "hygiene",
+    "bare-except": "hygiene",
+    "thread-daemon": "hygiene",
+    "precision": "hygiene",
+    "raw-conn": "raw-conn",
+    "alert-doc": "alerts",
+    "unlocked": "concurrency",
+    "condvar-if": "concurrency",
+    "lock-order": "concurrency",
+    "thread-leak": "concurrency",
+}
+
+
+@register("suppressions")
+def suppressions_rule(tree: Tree) -> list[Finding]:
+    """Stale-escape audit: a ``# lint: allow-<key>(reason)`` comment
+    whose rule produced no finding on that line is itself a finding —
+    the violation it excused is gone (or moved), and a rotting escape
+    is a hole the next real violation walks through. An unknown key
+    never matches any rule and is always a finding. ``run_lint`` runs
+    this family last, so every other selected rule has already recorded
+    which escapes it consumed (``Module.used_suppressions``)."""
+    selected = set(tree.selected)
+    findings: list[Finding] = []
+    for mod in tree.modules:
+        for line in sorted(mod.suppressions):
+            for key in sorted(mod.suppressions[line]):
+                family = SUPPRESSION_FAMILIES.get(key)
+                if family is None:
+                    findings.append(Finding(
+                        "suppressions", "unknown_suppression_key",
+                        mod.path, line,
+                        f"# lint: allow-{key}(...) names no known rule "
+                        f"key; known: {', '.join(sorted(SUPPRESSION_FAMILIES))}",
+                    ))
+                    continue
+                if family not in selected:
+                    continue
+                if (line, key) not in mod.used_suppressions:
+                    findings.append(Finding(
+                        "suppressions", "unused_suppression",
+                        mod.path, line,
+                        f"# lint: allow-{key}(...) suppresses nothing — "
+                        f"the {family} rule produced no finding here; "
+                        "delete the stale escape (or move it back onto "
+                        "the line it excuses)",
                     ))
     return findings

@@ -837,9 +837,9 @@ def main(argv=None) -> None:
                             help="repo-native static analysis "
                                  "(featurenet_tpu.analysis): enforce the "
                                  "telemetry, fault-site, host-sync, "
-                                 "timing-hygiene, and config/CLI contracts "
-                                 "over the package's own AST; exits 2 on "
-                                 "findings")
+                                 "timing-hygiene, config/CLI, and "
+                                 "concurrency contracts over the "
+                                 "package's own AST; exits 2 on findings")
     p_lint.add_argument("path", nargs="?", default=None,
                         help="directory (or single file) to lint; default: "
                              "the installed featurenet_tpu package. A path "
@@ -848,13 +848,26 @@ def main(argv=None) -> None:
                              "the reported findings to that subtree; a "
                              "path outside is linted as its own tree")
     p_lint.add_argument("--json", action="store_true", dest="as_json",
-                        help="one JSON object per finding plus a summary "
-                             "record, instead of the text rendering")
+                        help="alias for --format json (one JSON object "
+                             "per finding plus a summary record)")
+    p_lint.add_argument("--format", dest="fmt", default=None,
+                        choices=("text", "json", "sarif"),
+                        help="output rendering: text (default), json "
+                             "(one object per finding), or sarif "
+                             "(SARIF 2.1.0 for CI code-scanning "
+                             "annotation)")
+    p_lint.add_argument("--changed", action="store_true",
+                        help="report only findings in files the git "
+                             "working tree changed vs HEAD (plus "
+                             "untracked files); package-level findings "
+                             "always survive. Falls back to the full "
+                             "lint when git is absent")
     p_lint.add_argument("--rule", action="append", dest="rules",
                         metavar="NAME",
                         help="run only this rule family (repeatable): "
                              "telemetry, fault-sites, host-sync, hygiene, "
-                             "config-cli, spans, alerts")
+                             "config-cli, spans, raw-conn, alerts, "
+                             "concurrency, suppressions")
     p_rep = sub.add_parser("report", allow_abbrev=False,
                            help="analyze a run directory's observability "
                                 "log (featurenet_tpu.obs): step-time "
@@ -1477,13 +1490,19 @@ def main(argv=None) -> None:
     if args.cmd == "lint":
         # Static analysis of the package itself: stdlib + ast only, no
         # backend — must run in CI preambles and on bare laptops.
-        from featurenet_tpu.analysis import format_findings, run_lint
+        from featurenet_tpu.analysis import (format_findings, format_sarif,
+                                             run_lint)
 
+        fmt = args.fmt or ("json" if args.as_json else "text")
         try:
-            findings = run_lint(args.path, rules=args.rules or None)
+            findings = run_lint(args.path, rules=args.rules or None,
+                                changed_only=args.changed)
         except (ValueError, OSError, SyntaxError) as e:
             raise SystemExit(f"lint: {e}")
-        print(format_findings(findings, as_json=args.as_json))
+        if fmt == "sarif":
+            print(format_sarif(findings))
+        else:
+            print(format_findings(findings, as_json=(fmt == "json")))
         if findings:
             raise SystemExit(2)
         return

@@ -188,12 +188,18 @@ class ReplicaManager:
 
     # -- spawn / supervision --------------------------------------------------
     def _spawn(self, r: _Replica) -> None:
-        self._spawns += 1
+        # The tick thread (respawn path) and the autoscaler thread
+        # (add_one) both reach here: the counter increment is a
+        # read-modify-write, so take the lock and capture the sequence
+        # number it produced for the fault-site key below.
+        with self._lock:
+            self._spawns += 1
+            spawn_seq = self._spawns
         hb = heartbeat_path(self.run_dir, r.slot)
         r.mon = HeartbeatMonitor(hb, self.stall_timeout_s, self.grace_s)
         r.mon.reset()
         argv = list(self.spawn(r.slot, hb))
-        if faults.maybe_fail("spawn_fail", spawn=self._spawns):
+        if faults.maybe_fail("spawn_fail", spawn=spawn_seq):
             import sys
 
             argv = [sys.executable, "-c", "raise SystemExit(13)"]
@@ -274,20 +280,25 @@ class ReplicaManager:
             _kill_tree(r.proc)
         was_ready = r.ready
         port = r.port
+        # Loss bookkeeping under the lock: stats()/candidates() read
+        # failures/_losses from router and autoscaler threads, and the
+        # backoff computation must see the failure count IT incremented.
+        # The pool retire stays outside — the pool has its own lock and
+        # this keeps the lock-order graph acyclic.
         with self._lock:
             r.proc = None
             r.port = None
             r.ready = False
+            r.was_lost = True
+            r.failures += 1
+            self._losses += 1
+            delay = min(self.backoff_cap_s,
+                        self.backoff_base_s * (2 ** (r.failures - 1)))
+            r.respawn_due = time.monotonic() + delay
         if port is not None:
             # A lost replica's channels are corpse sockets: retire them
             # NOW so no forward (or probe) inherits one.
             self.pool.retire_endpoint(self.host, port, "replica_loss")
-        r.was_lost = True
-        r.failures += 1
-        self._losses += 1
-        delay = min(self.backoff_cap_s,
-                    self.backoff_base_s * (2 ** (r.failures - 1)))
-        r.respawn_due = time.monotonic() + delay
         obs.emit("fleet_replica_loss", replica=r.slot, reason=reason)
         if was_ready:
             self._write_roster("replica_loss")
@@ -329,6 +340,7 @@ class ReplicaManager:
                 launch = not r.probe_inflight
                 r.probe_inflight = launch
             if launch:
+                # lint: allow-thread-leak(bounded to one in-flight per replica by the probe_inflight gate above; self-terminating after one probe round-trip, daemon so a wedged probe cannot block interpreter exit)
                 threading.Thread(
                     target=self._probe_update, args=(r,),
                     name=f"fleet-probe-{r.slot}", daemon=True,

@@ -131,7 +131,10 @@ class TimeSeriesStore:
         path is absorbed: a dark store drops samples and counts them —
         telemetry is never load-bearing."""
         if self._dark:
-            self.dropped += 1
+            # Same discipline as ``appended``: concurrent appenders are
+            # supported, so the counter read-modify-write takes the lock.
+            with self._lock:
+                self.dropped += 1
             return False
         if t is None:
             t = time.time()
@@ -159,7 +162,8 @@ class TimeSeriesStore:
             # Disk full / unlinked root / fd limit: go dark for good.
             # A degraded store must never raise into the scrape loop.
             self._go_dark()
-            self.dropped += 1
+            with self._lock:
+                self.dropped += 1
             return False
 
     def close(self) -> None:

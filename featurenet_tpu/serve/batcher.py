@@ -335,7 +335,12 @@ class ContinuousBatcher:
                 try:
                     self.on_reject(p)
                 except Exception:
-                    self._hook_errors += 1
+                    # Racy with _dispatch's increment (HTTP handler
+                    # thread vs dispatcher thread): += on an int is a
+                    # read-modify-write, so concurrent failures could
+                    # drop counts without the lock.
+                    with self._cv:
+                        self._hook_errors += 1
             raise OverloadError(depth, self.queue_limit,
                                 trace_id=ctx.trace_id, lane=lane,
                                 retry_after_s=self.retry_after_s)
@@ -449,7 +454,11 @@ class ContinuousBatcher:
                         "error" if err is not None else "ok",
                     )
                 except Exception:
-                    self._hook_errors += 1
+                    # Same counter as submit()'s reject-hook path: two
+                    # threads, one int — take the lock for the
+                    # read-modify-write.
+                    with self._cv:
+                        self._hook_errors += 1
         with self._cv:
             self._batches += 1
             self._rows += n

@@ -699,7 +699,7 @@ def test_rule_registry_populated_at_import():
 
     assert set(RULE_NAMES) == {
         "telemetry", "fault-sites", "host-sync", "hygiene", "config-cli",
-        "spans", "raw-conn", "alerts",
+        "spans", "raw-conn", "alerts", "concurrency", "suppressions",
     }
     assert set(RULES) == set(RULE_NAMES)
 
@@ -811,3 +811,417 @@ def test_raw_conn_bare_name_and_https_caught(tmp_path):
     """)
     findings = run_lint(str(tmp_path), rules=["raw-conn"])
     assert _checks(findings) == ["raw_connection", "raw_connection"]
+
+
+# --- rule: concurrency -------------------------------------------------------
+
+_UNLOCKED_FIXTURE = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            self._count += 1{suffix}
+
+        def bump(self):
+            with self._lock:
+                self._count += 1
+
+        def stop(self):
+            self._t.join()
+"""
+
+
+def test_concurrency_unlocked_write_caught_with_location(tmp_path):
+    path = _write(tmp_path, "w.py",
+                  _UNLOCKED_FIXTURE.format(suffix=""))
+    findings = run_lint(str(tmp_path), rules=["concurrency"])
+    assert _checks(findings) == ["unlocked_write"]
+    assert findings[0].path == path and findings[0].line == 14
+    assert "Worker._count" in findings[0].msg
+    assert "_lock" in findings[0].msg
+
+
+def test_concurrency_unlocked_write_locked_and_suppressed_pass(tmp_path):
+    # Locked variant: the thread-path write holds the lock.
+    _write(tmp_path, "w.py", """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._t = None
+
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self._count += 1
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+
+            def stop(self):
+                self._t.join()
+    """)
+    assert run_lint(str(tmp_path), rules=["concurrency"]) == []
+    # Suppressed variant: the escape is honored AND counts as consumed
+    # for the suppression audit.
+    _write(tmp_path, "w.py", _UNLOCKED_FIXTURE.format(
+        suffix="  # lint: allow-unlocked(fixture says single-writer)"))
+    assert run_lint(str(tmp_path),
+                    rules=["concurrency", "suppressions"]) == []
+
+
+def test_concurrency_single_writer_and_lockless_class_exempt(tmp_path):
+    # One writer method only -> out of contract even on a thread path;
+    # a class with no lock at all guards nothing.
+    _write(tmp_path, "w.py", """\
+        import threading
+
+        class OneWriter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def _run(self):
+                self._n += 1
+
+        class NoLock:
+            def a(self):
+                self._x = 1
+
+            def b(self):
+                self._x = 2
+    """)
+    assert run_lint(str(tmp_path), rules=["concurrency"]) == []
+
+
+def test_concurrency_condvar_wait_under_if_caught(tmp_path):
+    path = _write(tmp_path, "q.py", """\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def get(self):
+                with self._cv:
+                    if not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+    """)
+    findings = run_lint(str(tmp_path), rules=["concurrency"])
+    assert _checks(findings) == ["condvar_wait_if"]
+    assert findings[0].path == path and findings[0].line == 11
+    assert "while" in findings[0].msg
+
+
+def test_concurrency_condvar_wait_in_while_and_wait_for_pass(tmp_path):
+    _write(tmp_path, "q.py", """\
+        import threading
+
+        cond = threading.Condition()
+        items = []
+
+        def get():
+            with cond:
+                while not items:
+                    cond.wait()
+                return items.pop()
+
+        def get2():
+            with cond:
+                cond.wait_for(lambda: items)
+                return items.pop()
+
+        def unrelated(ev):
+            if True:
+                ev.wait()  # Event.wait: level-triggered, not a condvar
+    """)
+    assert run_lint(str(tmp_path), rules=["concurrency"]) == []
+
+
+def test_concurrency_lock_order_cycle_with_edge_locations(tmp_path):
+    _write(tmp_path, "locks.py", """\
+        import threading
+
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def one():
+            with a:
+                with b:
+                    pass
+
+        def two():
+            with b:
+                with a:
+                    pass
+    """)
+    findings = run_lint(str(tmp_path), rules=["concurrency"])
+    assert _checks(findings) == ["lock_order_cycle"]
+    msg = findings[0].msg
+    # Both edges render with file:line so the operator can walk the cycle.
+    assert "locks.py:8" in msg and "locks.py:13" in msg
+    assert "locks.py:a" in msg and "locks.py:b" in msg
+
+
+def test_concurrency_lock_order_consistent_and_suppressed_pass(tmp_path):
+    # Same nesting order everywhere: a DAG, no finding.
+    _write(tmp_path, "locks.py", """\
+        import threading
+
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def one():
+            with a:
+                with b:
+                    pass
+
+        def two():
+            with a:
+                with b:
+                    pass
+    """)
+    assert run_lint(str(tmp_path), rules=["concurrency"]) == []
+    # A deliberate cycle edge carries a reasoned escape on the inner
+    # acquisition line.
+    _write(tmp_path, "locks.py", """\
+        import threading
+
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def one():
+            with a:
+                with b:  # lint: allow-lock-order(b holders never take a)
+                    pass
+
+        def two():
+            with b:
+                with a:
+                    pass
+    """)
+    assert run_lint(str(tmp_path),
+                    rules=["concurrency", "suppressions"]) == []
+
+
+def test_concurrency_thread_leak_caught_and_snapshot_join_passes(tmp_path):
+    path = _write(tmp_path, "d.py", """\
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self._t = None
+
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+    """)
+    findings = run_lint(str(tmp_path), rules=["concurrency"])
+    assert _checks(findings) == ["thread_leak"]
+    assert findings[0].path == path and findings[0].line == 8
+    assert "Daemon._t" in findings[0].msg
+    # The race-free shutdown idiom — snapshot the attr, join the local —
+    # must count as a join (autoscaler/scraper stop() pattern).
+    _write(tmp_path, "d.py", """\
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self._t = None
+
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                t = self._t
+                if t is not None:
+                    t.join(timeout=5.0)
+    """)
+    assert run_lint(str(tmp_path), rules=["concurrency"]) == []
+
+
+def test_concurrency_fire_and_forget_thread_caught_and_suppressed(tmp_path):
+    src = """\
+        import threading
+
+        class Spawner:
+            def kick(self):
+                threading.Thread(target=self._work, daemon=True).start(){s}
+
+            def _work(self):
+                pass
+    """
+    path = _write(tmp_path, "s.py", src.format(s=""))
+    findings = run_lint(str(tmp_path), rules=["concurrency"])
+    assert _checks(findings) == ["thread_leak"]
+    assert findings[0].path == path and findings[0].line == 5
+    assert "fire-and-forget" in findings[0].msg
+    _write(tmp_path, "s.py", src.format(
+        s="  # lint: allow-thread-leak(bounded and self-terminating)"))
+    assert run_lint(str(tmp_path),
+                    rules=["concurrency", "suppressions"]) == []
+
+
+# --- rule: suppressions (stale-escape audit) ---------------------------------
+
+def test_suppressions_stale_escape_is_a_finding(tmp_path):
+    # The annotated line produces no hygiene finding -> the escape rots.
+    path = _write(tmp_path, "m.py", """\
+        x = 1  # lint: allow-wall-clock(nothing here needs this)
+    """)
+    findings = run_lint(str(tmp_path), rules=["hygiene", "suppressions"])
+    assert _checks(findings) == ["unused_suppression"]
+    assert findings[0].path == path and findings[0].line == 1
+    assert "allow-wall-clock" in findings[0].msg
+
+
+def test_suppressions_live_escape_not_flagged(tmp_path):
+    _write(tmp_path, "m.py", """\
+        import threading
+
+        def f():
+            pass
+
+        t = threading.Thread(target=f)  # lint: allow-thread-daemon(fixture)
+    """)
+    assert run_lint(str(tmp_path), rules=["hygiene", "suppressions"]) == []
+
+
+def test_suppressions_unknown_key_always_flagged(tmp_path):
+    _write(tmp_path, "m.py", """\
+        x = 1  # lint: allow-bogus-key(no rule owns this)
+    """)
+    findings = run_lint(str(tmp_path), rules=["suppressions"])
+    assert _checks(findings) == ["unknown_suppression_key"]
+    assert "bogus-key" in findings[0].msg
+
+
+def test_suppressions_only_judge_selected_families(tmp_path):
+    """`--rule hygiene` must not flag another family's (possibly live)
+    escapes: the owning rule never ran, so it never had the chance to
+    consume them."""
+    _write(tmp_path, "m.py", """\
+        x = 1  # lint: allow-unlocked(concurrency owns this key)
+    """)
+    assert run_lint(str(tmp_path),
+                    rules=["hygiene", "suppressions"]) == []
+    findings = run_lint(str(tmp_path),
+                        rules=["concurrency", "suppressions"])
+    assert _checks(findings) == ["unused_suppression"]
+
+
+def test_suppressions_docstring_mention_is_not_an_escape(tmp_path):
+    """Documentation that QUOTES the syntax (docstrings, block comments
+    explaining a rule) must not register as a live suppression — only a
+    comment that IS the directive counts."""
+    _write(tmp_path, "m.py", '''\
+        """Suppress with ``# lint: allow-wall-clock(reason)``."""
+
+        # Deliberate sites carry # lint: allow-wall-clock(<why>) markers.
+        x = 1
+    ''')
+    assert run_lint(str(tmp_path), rules=["hygiene", "suppressions"]) == []
+
+
+# --- CLI: --format sarif / --changed -----------------------------------------
+
+def test_cli_lint_sarif_output_parses(tmp_path, capsys):
+    from featurenet_tpu.cli import main
+
+    _write(tmp_path, "q.py", """\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ok = False
+
+            def get(self):
+                with self._cv:
+                    if not self._ok:
+                        self._cv.wait()
+    """)
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", str(tmp_path), "--format", "sarif",
+              "--rule", "concurrency"])
+    assert exc.value.code == 2
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "featurenet-lint"
+    ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert ids == ["concurrency/condvar_wait_if"]
+    res = run["results"][0]
+    assert res["ruleId"] == "concurrency/condvar_wait_if"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("q.py")
+    assert loc["region"]["startLine"] == 11
+    # A clean tree still emits a valid (empty-results) SARIF log.
+    _write(tmp_path, "q.py", "x = 1\n")
+    main(["lint", str(tmp_path), "--format", "sarif",
+          "--rule", "concurrency"])
+    clean = json.loads(capsys.readouterr().out)
+    assert clean["runs"][0]["results"] == []
+
+
+def test_cli_lint_changed_scopes_to_git_diff(tmp_path):
+    import subprocess
+
+    git = ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+           "-c", "user.name=t"]
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    violation = 'x = 1  # lint: allow-wall-clock(stale on purpose)\n'
+    _write(tmp_path, "a.py", violation)
+    subprocess.run(git + ["add", "-A"], check=True)
+    subprocess.run(git + ["commit", "-qm", "seed"], check=True)
+    # a.py is committed and unchanged: its finding is scoped away.
+    assert run_lint(str(tmp_path), rules=["hygiene", "suppressions"],
+                    changed_only=True) == []
+    # An untracked file's findings ARE in scope.
+    _write(tmp_path, "b.py", violation)
+    findings = run_lint(str(tmp_path), rules=["hygiene", "suppressions"],
+                        changed_only=True)
+    assert [os.path.basename(f.path) for f in findings] == ["b.py"]
+    # Without --changed the unchanged file's finding is still reported.
+    full = run_lint(str(tmp_path), rules=["hygiene", "suppressions"])
+    assert sorted(os.path.basename(f.path) for f in full) == \
+        ["a.py", "b.py"]
+
+
+def test_cli_lint_changed_without_git_falls_back_to_full(tmp_path,
+                                                         monkeypatch):
+    """No work tree (or no git binary): --changed degrades to the full
+    lint — never a silently-empty one."""
+    from featurenet_tpu.analysis import lint as lint_mod
+
+    _write(tmp_path, "a.py",
+           "x = 1  # lint: allow-wall-clock(stale on purpose)\n")
+    monkeypatch.setattr(lint_mod, "_git_changed_files", lambda root: None)
+    findings = run_lint(str(tmp_path), rules=["hygiene", "suppressions"],
+                        changed_only=True)
+    assert _checks(findings) == ["unused_suppression"]

@@ -2032,3 +2032,67 @@ def test_fleet_e2e_replica_death_mid_rollout_rolls_back(
     rep = build_report(events)
     assert rep["fleet"]["rollout"]["rollbacks"] == 1
     assert rep["fleet"]["rollout"]["ok"] is False
+
+
+def test_spawn_and_loss_counters_exact_under_concurrent_threads(tmp_path):
+    """Regression (concurrency lint): ``_spawn`` runs on both the tick
+    thread (respawns) and the autoscaler thread (``add_one``), and
+    ``_lose``'s failure/backoff bookkeeping is read by router and
+    autoscaler threads — both now take ``_lock`` for their
+    read-modify-writes, so the counters must come out exact."""
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+
+    def spawn(slot, hb):
+        return [sys.executable, "-c", "pass"]  # exits immediately
+
+    n = 12
+    manager = ReplicaManager(n, spawn, run_dir)
+    try:
+        replicas = list(manager._replicas.values())
+        errs: list = []
+
+        def spawn_some(rs):
+            try:
+                for r in rs:
+                    manager._spawn(r)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=spawn_some, args=(replicas[i::4],),
+                             daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert errs == []
+        assert manager.stats()["spawns"] == n
+
+        def lose_some(rs):
+            try:
+                for r in rs:
+                    manager._lose(r, "test_loss")
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=lose_some, args=(replicas[i::4],),
+                             daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert errs == []
+        st = manager.stats()
+        assert st["losses"] == n
+        # Per-replica bookkeeping landed too: one charged failure each,
+        # with a respawn backoff scheduled from that count.
+        assert all(r.failures == 1 and r.respawn_due > 0
+                   for r in replicas)
+    finally:
+        manager.stop(timeout_s=10.0)

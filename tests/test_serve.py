@@ -243,6 +243,66 @@ def test_forward_error_resolves_batch_and_batcher_survives():
     assert st["errors"] == 1 and st["served"] == 1
 
 
+def test_hook_error_counter_exact_across_threads():
+    """Regression (concurrency lint): ``_hook_errors`` is bumped from the
+    submit path (HTTP handler threads, reject hook) AND the dispatcher
+    thread (result hook) — both increments must hold ``_cv`` or
+    concurrent failures lose counts. Every fired hook raises, so the
+    counter must equal exactly (answered requests) + (rejections)."""
+
+    def bad_hook(*a):
+        raise RuntimeError("hook boom")
+
+    b = ContinuousBatcher(_sum_forward(), buckets=(1, 4), max_wait_ms=1,
+                          queue_limit=1024, on_result=bad_hook,
+                          on_reject=bad_hook)
+    n_threads, per_thread = 8, 16
+    done = []
+    lock = threading.Lock()
+
+    def submit_many():
+        for _ in range(per_thread):
+            b.submit(np.ones((2,))).result(30)
+        with lock:
+            done.append(1)
+
+    threads = [threading.Thread(target=submit_many, daemon=True)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    st = b.drain()
+    assert len(done) == n_threads
+    assert st["served"] == n_threads * per_thread
+    # One on_result failure per answered request, zero rejects here.
+    assert b._hook_errors == n_threads * per_thread
+
+    # The reject path charges the same counter from the caller's thread.
+    gate = threading.Event()
+
+    def blocked(bucket, arr):
+        gate.wait(30)
+        return arr.reshape(arr.shape[0], -1).sum(axis=1)
+
+    b2 = ContinuousBatcher(blocked, buckets=(1,), max_wait_ms=1,
+                           queue_limit=1, on_reject=bad_hook)
+    futs = [b2.submit(np.ones((1,)))]  # dispatcher picks this up, blocks
+    time.sleep(0.2)
+    futs.append(b2.submit(np.ones((1,))))  # fills the queue
+    rejects = 0
+    for _ in range(5):
+        with pytest.raises(OverloadError):
+            b2.submit(np.ones((1,)))
+        rejects += 1
+    gate.set()
+    for f in futs:
+        f.result(30)
+    st2 = b2.drain()
+    assert st2["rejected"] == rejects
+    assert b2._hook_errors == rejects
+
+
 def test_drain_refuses_new_requests():
     b = ContinuousBatcher(_sum_forward(), buckets=(1,), max_wait_ms=1)
     b.drain()

@@ -160,6 +160,35 @@ def test_tsdb_goes_dark_on_oserror(tmp_path):
     store.close()
 
 
+def test_tsdb_drop_counter_exact_under_concurrent_appends(tmp_path):
+    """Regression (concurrency lint): ``dropped`` is bumped on the dark
+    path from whatever thread held the sample — concurrent appenders
+    are part of the store's contract, so the counter read-modify-write
+    must hold ``_lock`` (as ``appended`` always did) and come out
+    exact."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    store = _tsdb.TimeSeriesStore(str(blocker / "ts"))
+    store.append("ready", 1.0)  # first append trips the dark latch
+    before = store.stats()["dropped"]
+    n_threads, per_thread = 8, 200
+
+    def hammer():
+        for i in range(per_thread):
+            store.append("ready", float(i))
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    st = store.stats()
+    assert st["dropped"] == before + n_threads * per_thread
+    assert st["appended"] == 0
+    store.close()
+
+
 # --- exposition parsing ------------------------------------------------------
 
 def test_parse_exposition_labels_escapes_and_garbage():
