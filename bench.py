@@ -380,6 +380,19 @@ def _measure_round(platform: str) -> dict:
         quality_row = measure_quality_overhead(cfg)
     except Exception as e:
         quality_row = {"quality_overhead_error": repr(e)[:500]}
+    # Incident-plane tax (obs.incidents): closed-loop rate through one
+    # fully-traced warmed service with an incident manager armed (the
+    # event tap installed, alert funnel watched, no incident open) vs
+    # dark. Pinned (max) under the same "telemetry is never
+    # load-bearing" contract; a failure degrades to an absent key with
+    # the error in-artifact.
+    from featurenet_tpu.serve.loadgen import measure_incident_overhead
+
+    incident_row: dict = {}
+    try:
+        incident_row = measure_incident_overhead(cfg)
+    except Exception as e:
+        incident_row = {"incident_overhead_error": repr(e)[:500]}
     # Serving-fleet robustness row (featurenet_tpu.fleet.loadgen): a
     # 2-replica CPU fleet (replicas forced onto JAX_PLATFORMS=cpu —
     # this row pins the ROUTER layer, deliberately independent of
@@ -614,6 +627,10 @@ def _measure_round(platform: str) -> dict:
         # measure_quality_overhead): the quality plane's hot-path cost,
         # pinned max like trace_overhead_pct.
         **quality_row,
+        # Incident-plane tax row (serve.loadgen.
+        # measure_incident_overhead): the cost of an ARMED incident
+        # manager on the emit path, pinned max like trace_overhead_pct.
+        **incident_row,
         # Fleet robustness row (fleet.loadgen.bench_fleet): router-level
         # sustained QPS / p99 through a mid-run replica kill, dropped
         # admitted requests (pinned 0), spillover/re-submit counts.

@@ -106,6 +106,12 @@ KNOWN_THREAD_ENTRY: dict[tuple[str, str], tuple[str, ...]] = {
     ("obs/quality.py", "QualityTracker"): ("observe", "stats"),
     # Scraper thread appends; the manager closes and queries.
     ("obs/tsdb.py", "TimeSeriesStore"): ("append", "close", "stats"),
+    # The event tap calls on_event from WHATEVER thread emitted (window
+    # aggregator threads, the burn evaluator, supervisor); /metrics
+    # exporters read open_count; the owning service drains via disarm.
+    ("obs/incidents.py", "IncidentManager"): (
+        "on_event", "open_count", "open_ids", "stats", "disarm",
+    ),
 }
 
 

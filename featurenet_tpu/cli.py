@@ -959,6 +959,29 @@ def main(argv=None) -> None:
                              "(obs.alerts.parse_slos, e.g. "
                              "'serving_p99_ms<250@99%%'); default: the "
                              "built-in serving objective")
+    p_incd = sub.add_parser("incident", allow_abbrev=False,
+                            help="post-mortem over alert-triggered "
+                                 "incident bundles "
+                                 "(featurenet_tpu.obs.incidents): list a "
+                                 "run dir's bundles or render one — "
+                                 "everything reads "
+                                 "<run_dir>/incidents/<id>/ alone, so it "
+                                 "works after the service that captured "
+                                 "them is long gone")
+    p_incd.add_argument("action", choices=["list", "show"],
+                        help="list: one line per bundle, oldest first; "
+                             "show: render one incident's full "
+                             "post-mortem (trigger, tsdb slice, window "
+                             "snapshots, events tail, folded thread "
+                             "stacks)")
+    p_incd.add_argument("run_dir", help="run directory (bundles live "
+                                        "under <run_dir>/incidents)")
+    p_incd.add_argument("incident_id", nargs="?", default=None,
+                        help="show only: incident id (default: the "
+                             "latest bundle)")
+    p_incd.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output: the bundle index "
+                             "(list) or the loaded bundle dict (show)")
     p_inf = sub.add_parser("infer", allow_abbrev=False,
                            help="classify or segment STL files with a "
                                 "trained checkpoint")
@@ -1485,6 +1508,50 @@ def main(argv=None) -> None:
             print()
         except ValueError as e:
             raise SystemExit(f"dash: {e}")
+        return
+
+    if args.cmd == "incident":
+        # Incident post-mortems: stdlib-only reads over the bundle
+        # directory — degraded bundles (torn manifest, pruned pieces)
+        # render with an explicit "missing" section, never a traceback.
+        from featurenet_tpu.obs import incidents as _incidents
+
+        if args.action == "list":
+            entries = _incidents.list_incidents(args.run_dir)
+            if args.as_json:
+                print(json.dumps(entries, indent=1, default=str))
+                return
+            if not entries:
+                print("no incident bundles under "
+                      f"{_incidents.incidents_dir(args.run_dir)}")
+                return
+            for e in entries:
+                dur = (f"  duration={e['duration_s']:.3f}s"
+                       if isinstance(e.get("duration_s"), (int, float))
+                       else "")
+                print(f"{e['id']}  rule={e.get('rule', '?')}  "
+                      f"severity={e.get('severity', '?')}  "
+                      f"state={e.get('state', '?')}{dur}")
+            return
+        incident_id = args.incident_id
+        if incident_id is None:
+            entries = _incidents.list_incidents(args.run_dir)
+            if not entries:
+                raise SystemExit(
+                    "incident show: no bundles under "
+                    f"{_incidents.incidents_dir(args.run_dir)}")
+            incident_id = entries[-1]["id"]
+        import os as _os
+
+        bundle = _incidents.load_bundle(args.run_dir, incident_id)
+        if not _os.path.isdir(bundle["dir"]):
+            raise SystemExit(
+                f"incident show: no bundle {incident_id!r} under "
+                f"{_incidents.incidents_dir(args.run_dir)}")
+        if args.as_json:
+            print(json.dumps(bundle, indent=1, default=str))
+        else:
+            print(_incidents.format_incident(bundle), end="")
         return
 
     if args.cmd == "lint":
@@ -2392,6 +2459,7 @@ def main(argv=None) -> None:
             batch_queue_limit=args.batch_queue_limit,
             replica=args.replica_id,
             quality=quality, recorder=recorder,
+            run_dir=getattr(args, "run_dir", None),
         )
         hb_stop = threading.Event()
         if args.heartbeat_file:
@@ -2553,10 +2621,14 @@ def main(argv=None) -> None:
             except ValueError as e:
                 raise SystemExit(f"--slos: {e}")
         store = _tsdb.TimeSeriesStore.open(args.run_dir)
+        # Mirror alert fire/resolve transitions into the store as
+        # alerts_active{rule} 0/1 series so `cli dash` and post-mortems
+        # can overlay alert state on the metric timelines.
+        _alerts.set_store(store)
         router = FleetRouter(
             manager, slo_p99_ms=args.slo_p99_ms,
             batch_shed_depth=args.batch_shed_depth,
-            store=store, slos=slos,
+            store=store, slos=slos, run_dir=args.run_dir,
         )
         manager.start()
         # The ACTING half of the control loop (opt-in): a manager-owned
@@ -2625,6 +2697,7 @@ def main(argv=None) -> None:
         st["scrape"] = scraper.stats()
         if autoscaler is not None:
             st["autoscale"] = autoscaler.stats()
+        _alerts.set_store(None)
         store.close()
         obs.close_run()
         print(json.dumps({"fleet_stats": st}))

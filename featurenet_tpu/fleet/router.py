@@ -129,7 +129,8 @@ class FleetRouter:
                  store=None,
                  slos: Optional[Sequence] = None,
                  burn_fast_s: float = _alerts.DEFAULT_FAST_WINDOW_S,
-                 burn_slow_s: float = _alerts.DEFAULT_SLOW_WINDOW_S):
+                 burn_slow_s: float = _alerts.DEFAULT_SLOW_WINDOW_S,
+                 run_dir: Optional[str] = None):
         self.fleet = fleet
         self.slo_p99_ms = float(slo_p99_ms)
         self.batch_shed_depth = int(batch_shed_depth)
@@ -180,6 +181,15 @@ class FleetRouter:
             rules = serve_rules(slo_p99_ms)
         if rules:
             _windows.install(_windows.WindowAggregator(rules=list(rules)))
+        # Incident plane (obs.incidents): with a run_dir the router owns
+        # the process-wide incident manager — a burn-rate alert or a
+        # replica-loss storm freezes a fleet-level diagnostic bundle
+        # (tsdb slice, roster, events tail, host stacks).
+        self._incidents = None
+        if run_dir is not None:
+            from featurenet_tpu.obs import incidents as _incidents
+
+            self._incidents = _incidents.arm(run_dir)
         self._last_verdict: Optional[str] = None
         self._scale_stop = threading.Event()
         self._scale_thread = threading.Thread(
@@ -494,6 +504,12 @@ class FleetRouter:
         self._scale_stop.set()
         self._scale_thread.join(timeout=2.0)
         _windows.flush()
+        # Final flush first: it may resolve alerts (closing incidents
+        # through the tap) so the bundle durations stay honest.
+        if self._incidents is not None:
+            from featurenet_tpu.obs import incidents as _incidents
+
+            _incidents.disarm(self._incidents)
         st = self.stats()
         # Retire the idle channel set — but only a pool the router
         # constructed: closing the manager's shared pool here would

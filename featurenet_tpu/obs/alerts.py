@@ -198,6 +198,24 @@ def parse_rules(spec: Optional[str]) -> list[AlertRule]:
     return rules
 
 
+# Alert-timeline mirror: when a time-series store is attached
+# (``set_store`` — the fleet CLI wires the scraper's store), every
+# fire/resolve transition ALSO lands as an ``alerts_active{rule}`` 0/1
+# sample, so dash and the report can render alert timelines from the
+# store alone, long after the emitting process exited. The store's
+# append already absorbs every failure (go-dark, drops counted), so the
+# mirror inherits the never-load-bearing contract for free.
+_store = None
+
+
+def set_store(store) -> None:
+    """Attach (or, with None, detach) the store that mirrors alert
+    transitions. One process, one store — the same discipline as the
+    event sink."""
+    global _store
+    _store = store
+
+
 def fire(rule: AlertRule, value: float, window: int,
          state: str = "fire") -> None:
     """One structured ``alert`` event — ``state="fire"`` when the rule
@@ -208,6 +226,10 @@ def fire(rule: AlertRule, value: float, window: int,
     _events.emit("alert", rule=rule.metric, severity=rule.severity,
                  value=round(float(value), 6), threshold=rule.threshold,
                  window=window, state=state)
+    store = _store
+    if store is not None:
+        store.append("alerts_active", 1.0 if state == "fire" else 0.0,
+                     {"rule": rule.metric})
 
 
 # --- multi-window burn-rate SLOs ---------------------------------------------

@@ -22,6 +22,7 @@ import time
 from typing import Optional
 
 from featurenet_tpu.obs import alerts as _alerts
+from featurenet_tpu.obs import incidents as _incidents
 from featurenet_tpu.obs import tsdb as _tsdb
 
 DEFAULT_WINDOW_S = 300.0
@@ -126,6 +127,7 @@ def render_frame(run_dir: str, *, window_s: float = DEFAULT_WINDOW_S,
             f"populated by the fleet scraper (`cli fleet --run-dir`), "
             f"so point dash at a fleet run_dir or wait for the first "
             f"scrape round\n"
+            f"{_incident_line(run_dir)}\n"
         )
     lines = [
         f"fleet dash · {run_dir} · window {window_s:g}s · "
@@ -239,4 +241,22 @@ def render_frame(run_dir: str, *, window_s: float = DEFAULT_WINDOW_S,
                 fails += int(last[1])
     lines.append(f"roster: {healthy}/{total} replicas ready · "
                  f"scrape failures: {fails}")
+    lines.append(_incident_line(run_dir))
     return "\n".join(lines) + "\n"
+
+
+def _incident_line(run_dir: str) -> str:
+    """The incident plane's one-line dash summary, from the bundle
+    directory alone: open/recent counts + the last incident's identity.
+    A run with no incidents renders a friendly empty state (``--once``
+    must stay CI-renderable on any run_dir)."""
+    bundles = _incidents.list_incidents(run_dir)
+    if not bundles:
+        return "incidents: none recorded"
+    n_open = sum(1 for b in bundles if b.get("state") == "open")
+    last = bundles[-1]
+    return (
+        f"incidents: {n_open} open · {len(bundles)} recent · last "
+        f"{last['id']} ({last.get('rule', '?')}, "
+        f"{last.get('state', '?')})"
+    )

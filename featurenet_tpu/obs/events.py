@@ -158,6 +158,18 @@ class EventSink:
                   "pid": self._pid}
         record.update(fields)
         data = (json.dumps(record, default=str) + "\n").encode("utf-8")
+        # The event tap (obs.incidents): consulted OUTSIDE the write
+        # lock below would reorder against the write; consulted here —
+        # before the lock — it sees every event this process emits (even
+        # after the sink goes dark: the incident plane has its own
+        # go-dark state and must still see alert transitions). Guarded:
+        # a tap must never raise into an emit site.
+        tap = _tap
+        if tap is not None:
+            try:
+                tap(ev, record)
+            except Exception:
+                pass
         with self._lock:
             if self._fd is None:
                 return
@@ -207,6 +219,23 @@ class EventSink:
 
 _sink: Optional[EventSink] = None
 _install_lock = threading.Lock()
+
+# The module-level event tap: ONE subscriber sees every event any sink
+# in this process emits (the incident manager's subscription point —
+# alert transitions, gate regressions, replica losses — with no
+# per-callsite wiring). Deliberately a single slot, not a listener
+# list: the obs layer has exactly one downstream consumer, and a second
+# would deserve its own design pass.
+_tap = None
+
+
+def set_tap(fn) -> None:
+    """Install (or, with None, remove) the process-wide event tap. The
+    tap is called as ``fn(ev, record)`` from the EMITTING thread, after
+    the record is built but before the write — it must be cheap and must
+    not raise (the emit site guards anyway)."""
+    global _tap
+    _tap = fn
 
 
 def init_run(run_dir: str, config: Optional[dict] = None,

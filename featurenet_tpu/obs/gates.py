@@ -134,6 +134,14 @@ DIRECTIONS = {
     # must never silently grow a hot-path cost, same contract as
     # trace_overhead_pct.
     "quality_overhead_pct": "max",
+    # Incident-plane tax (serve.loadgen.measure_incident_overhead):
+    # closed-loop rate through a fully-traced service with an incident
+    # manager armed (event tap installed, alert funnel watched, no
+    # incident open) vs dark. Being ARMED must stay near-free — a
+    # capture is alert-gated and runs on its own thread, but the tap
+    # consult rides every emit, so its cost is pinned like
+    # trace_overhead_pct.
+    "incident_overhead_pct": "max",
     # Telemetry-collection tax (fleet.loadgen.bench_fleet): open-loop
     # fleet qps with the scraper collecting vs paused, same warm fleet.
     # Regresses UPWARD for the same reason as trace_overhead_pct —
@@ -288,6 +296,7 @@ BENCH_GATE_KEYS = (
     "serve_rejected",
     "trace_overhead_pct",
     "quality_overhead_pct",
+    "incident_overhead_pct",
     # Scaling-efficiency gate: samples/sec per mesh shape plus the
     # cross-host data-wait spread of the 2-host probe run — present only
     # when the round could measure them (device count / probe success),
@@ -374,6 +383,8 @@ NOISY_KEY_ABS_SLACK = {
     # The quality tax rides the same closed-loop A/B as the trace tax
     # and inherits its run-to-run noise floor — same absolute room.
     "quality_overhead_pct": 10.0,
+    # The incident tax rides the same A/B and noise floor too.
+    "incident_overhead_pct": 10.0,
     "data_wait_spread": 0.1,
     "fleet_p99_ms": 25.0,
     "fleet_conn_reuse_ratio": 0.05,

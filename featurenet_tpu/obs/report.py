@@ -972,6 +972,30 @@ def build_report(events: list[dict], manifest: Optional[dict] = None,
     if traces:
         rep["traces"] = traces
 
+    # --- incident plane (obs.incidents) --------------------------------------
+    # Folded from the event stream (open/capture/close across every
+    # host); build_report_dir adds the on-disk bundle inventory, which
+    # outlives the stream's tail.
+    inc_open = [e for e in events if e["ev"] == "incident_open"]
+    inc_close = [e for e in events if e["ev"] == "incident_close"]
+    if inc_open or inc_close:
+        closed_ids = {e.get("id") for e in inc_close}
+        by_rule: dict[str, int] = {}
+        for e in inc_open:
+            r = str(e.get("rule", "?"))
+            by_rule[r] = by_rule.get(r, 0) + 1
+        rep["incidents"] = {
+            "opened": len(inc_open),
+            "closed": len(inc_close),
+            "by_rule": by_rule,
+            "still_open": sorted(
+                str(e.get("id")) for e in inc_open
+                if e.get("id") not in closed_ids
+            ),
+            "durations_s": [e.get("duration_s") for e in inc_close
+                            if e.get("duration_s") is not None],
+        }
+
     # --- warnings / metrics -------------------------------------------------
     # Warnings aggregate across every host (a warning on host 3 must not
     # be invisible in the headline); metrics records would be N-fold
@@ -1063,6 +1087,14 @@ def build_report_dir(run_dir: str) -> dict:
     timeline = fleet_timeline_section(run_dir)
     if timeline is not None:
         rep["fleet_timeline"] = timeline
+    # Bundle inventory from disk: bundles outlive the event stream's
+    # tail (and survive a dark sink), so the report lists them even when
+    # no incident_* event made it into the log.
+    from featurenet_tpu.obs import incidents as _incidents
+
+    bundles = _incidents.list_incidents(run_dir)
+    if bundles:
+        rep.setdefault("incidents", {})["bundles"] = bundles
     return rep
 
 
@@ -1451,6 +1483,24 @@ def format_report(rep: dict) -> str:
                     f"{str(row.get('bucket') or '—'):>6}  "
                     f"{row.get('outcome')}"
                 )
+    inc = rep.get("incidents")
+    if inc:
+        head = (f"incidents: {inc.get('opened', 0)} opened, "
+                f"{inc.get('closed', 0)} closed")
+        if inc.get("by_rule"):
+            head += " (" + ", ".join(
+                f"{k}×{v}" for k, v in sorted(inc["by_rule"].items())
+            ) + ")"
+        if inc.get("still_open"):
+            head += "; STILL OPEN: " + ", ".join(inc["still_open"])
+        lines.append(head)
+        for b in inc.get("bundles", ()):
+            lines.append(
+                f"  {b['id']}  rule={b.get('rule', '?')} "
+                f"state={b.get('state', '?')}"
+                + (f" duration={b['duration_s']}s"
+                   if b.get("duration_s") is not None else "")
+            )
     w = rep.get("warnings")
     if w:
         lines.append(
@@ -1726,6 +1776,12 @@ KNOWN_EVENT_KINDS = frozenset({
     # slo_breach), and a replay canary's verdict (agreement of a
     # candidate against a recorded capture ring).
     "quality_drift", "capture", "replay_verdict",
+    # Incident plane (obs.incidents): an alert firing opened a
+    # diagnostic bundle (at most one per rule, flap-damped by a
+    # post-close cooldown), its capture landed on disk (tsdb slice /
+    # windows / roster / events tail / folded host stacks), and the
+    # paired resolve closed it with its duration.
+    "incident_open", "incident_capture", "incident_close",
 })
 
 # Fields (beyond t/ev) a record must carry for the report to fold it.
@@ -1783,6 +1839,9 @@ REQUIRED_EVENT_FIELDS = {
     "quality_drift": ("score", "n"),
     "capture": ("trace", "reason"),
     "replay_verdict": ("agreement", "n", "ok"),
+    "incident_open": ("id", "rule", "severity", "value"),
+    "incident_capture": ("id", "files"),
+    "incident_close": ("id", "rule", "duration_s"),
 }
 
 # The event kinds that carry a per-request ``trace`` id — the timeline

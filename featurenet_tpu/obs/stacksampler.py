@@ -1,0 +1,156 @@
+"""Host-side thread-stack sampler: N seconds of folded stacks, stdlib-only.
+
+The perf layer (``obs.perf``) attributes *device* time — compiled-program
+flops over wall against the chip peak. Nothing so far attributes *host*
+Python time: the batcher's dispatcher thread, the fleet router's workers,
+the scraper, the autoscaler, the tsdb writer all burn CPU that no
+existing telemetry can localize. This module is the host-side
+complement: a sampling profiler over ``sys._current_frames()`` that
+needs no signal handlers, no native extension, and no cooperation from
+the sampled threads.
+
+``sample_stacks`` polls every thread's current frame at ``hz`` for
+``duration_s`` and folds each observation into the standard
+flamegraph-folded form::
+
+    <thread name>;file.py:outermost;...;file.py:innermost <count>
+
+Thread NAMES lead each stack (resolved via ``threading.enumerate`` each
+tick, so late-spawned threads are attributed too) — "where did host CPU
+go" is only actionable when the answer names ``serve-batcher`` or
+``fleet-scale``, not an integer ident.
+
+Overrun discipline: the sampler runs inside an incident capture with a
+run to finish around it, so it carries a **hard wall-clock deadline**
+(``max_wall_s``, default 2× the requested duration). A machine so loaded
+that sampling itself lags — exactly when a profile is most interesting —
+ends the loop at the deadline and keeps the partial profile, marked
+``truncated``: a late answer beats none, and the sampler must never wedge
+the capture thread it runs on.
+
+Sampling another thread's frame is inherently racy (the GIL makes each
+``_current_frames`` snapshot internally consistent, but a frame may be
+mid-return); folding only (filename, name) pairs keeps every tick valid.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+DEFAULT_HZ = 50.0
+DEFAULT_DURATION_S = 2.0
+
+
+def _thread_names() -> dict[int, str]:
+    """ident → name for every live thread (re-resolved per tick: threads
+    spawned mid-profile still get named)."""
+    return {t.ident: t.name for t in threading.enumerate()
+            if t.ident is not None}
+
+
+def _fold_frame(frame) -> str:
+    """One thread's current stack as ``file:func;...`` outermost-first.
+    Semicolons/spaces cannot occur in the segments (filenames are
+    basenames, code names are identifiers), so the folded grammar stays
+    parseable."""
+    parts: list[str] = []
+    f = frame
+    while f is not None:
+        code = f.f_code
+        parts.append(
+            f"{os.path.basename(code.co_filename)}:{code.co_name}"
+        )
+        f = f.f_back
+    parts.reverse()
+    return ";".join(parts)
+
+
+def sample_stacks(duration_s: float = DEFAULT_DURATION_S,
+                  hz: float = DEFAULT_HZ,
+                  max_wall_s: Optional[float] = None) -> dict:
+    """Sample every thread's stack for ``duration_s`` at ``hz``; returns
+    ``{"folded": {stack: count}, "samples": n, "ticks": t,
+    "duration_s": wall, "truncated": bool}``. The calling thread itself
+    is excluded (profiling the profiler is noise). ``max_wall_s`` is the
+    hard overrun deadline (default ``2 * duration_s``): a loop that
+    cannot keep cadence stops there with the partial profile kept."""
+    duration_s = max(0.0, float(duration_s))
+    interval = 1.0 / max(1.0, float(hz))
+    if max_wall_s is None:
+        max_wall_s = 2.0 * duration_s
+    self_ident = threading.get_ident()
+    folded: dict[str, int] = {}
+    ticks = samples = 0
+    truncated = False
+    t0 = time.monotonic()
+    deadline = t0 + max(float(max_wall_s), interval)
+    end = t0 + duration_s
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            break
+        if now >= deadline:
+            # Overrun: the host is too loaded for the requested cadence
+            # (which is itself evidence). Keep what we have.
+            truncated = True
+            break
+        names = _thread_names()
+        # One internally-consistent snapshot of every thread's frame.
+        frames = sys._current_frames()
+        ticks += 1
+        for ident, frame in frames.items():
+            if ident == self_ident:
+                continue
+            name = names.get(ident, f"thread-{ident}")
+            stack = f"{name};{_fold_frame(frame)}"
+            folded[stack] = folded.get(stack, 0) + 1
+            samples += 1
+        del frames  # drop the frame refs before sleeping
+        time.sleep(interval)
+    return {
+        "folded": folded,
+        "samples": samples,
+        "ticks": ticks,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "truncated": truncated,
+    }
+
+
+def render_folded(profile: dict) -> str:
+    """The profile's ``folded`` dict as standard folded-stack text (one
+    ``stack count`` line, count-descending) — the form every flamegraph
+    tool ingests, and what an incident bundle stores."""
+    folded = profile.get("folded") or {}
+    lines = [f"{stack} {count}" for stack, count in
+             sorted(folded.items(), key=lambda kv: (-kv[1], kv[0]))]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_folded(text: str) -> dict[str, int]:
+    """Inverse of ``render_folded`` (tolerant: malformed lines are
+    skipped, a torn tail must not kill a post-mortem render)."""
+    out: dict[str, int] = {}
+    for line in text.splitlines():
+        stack, _, count = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            out[stack] = out.get(stack, 0) + int(count)
+        except ValueError:
+            continue
+    return out
+
+
+def thread_totals(folded: dict[str, int]) -> dict[str, int]:
+    """Per-thread sample totals from a folded dict (the first segment of
+    every stack is the thread name) — the one-line summary ``cli
+    incident show`` leads with."""
+    out: dict[str, int] = {}
+    for stack, count in folded.items():
+        name = stack.split(";", 1)[0]
+        out[name] = out.get(name, 0) + count
+    return out

@@ -115,6 +115,22 @@ _counters = {"admitted": 0, "done": 0, "sampled": 0, "forced": 0,
              "rejected": 0}
 _counters_lock = threading.Lock()
 
+# Incident mode (obs.incidents): while an incident is open, EVERY
+# request's timeline is kept — the bundle's events tail must hold the
+# bad window's complete traces, not a rate-sampled subset. This is the
+# tail-bias hook widened to everything; reset with the run like the
+# counters (a leaked flag would silently un-sample-rate the next run).
+_force_all = False
+
+
+def set_force_all(on: bool) -> None:
+    global _force_all
+    _force_all = bool(on)
+
+
+def force_all() -> bool:
+    return _force_all
+
 
 def counters() -> dict:
     with _counters_lock:
@@ -122,6 +138,8 @@ def counters() -> dict:
 
 
 def reset_counters() -> None:
+    global _force_all
+    _force_all = False
     with _counters_lock:
         for k in _counters:
             _counters[k] = 0
@@ -207,7 +225,7 @@ def done(ctx: Optional[TraceContext], queue_wait_ms: float,
     if ctx is None or ctx._finished:
         return
     ctx._finished = True
-    forced = outcome != "ok" or (
+    forced = _force_all or outcome != "ok" or (
         slo_ms is not None and total_ms > slo_ms
     )
     keep = forced or sampled(ctx.trace_id, ctx.sample_rate)

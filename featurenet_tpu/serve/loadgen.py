@@ -364,6 +364,71 @@ def measure_trace_overhead(cfg, n_requests: int = 192,
     }
 
 
+def measure_incident_overhead(cfg, n_requests: int = 192,
+                              buckets: Sequence[int] = (1, 4, 16),
+                              run_dir: Optional[str] = None) -> dict:
+    """The incident plane's steady-state tax, measured: closed-loop
+    request rate through one warmed service with NO incident manager
+    armed vs one armed on the run_dir (the event tap installed, the
+    alert funnel watched), same session so the executables are
+    identical. Both phases run fully traced (``trace_sample=1``) so the
+    tap sits on the real per-request emit path — an incident manager's
+    quiescent cost IS the tap consult per event plus the force-all flag
+    read per request. ``rules=()`` so no alert ever fires and no
+    incident opens: this pins the price of being ARMED, not of a
+    capture (captures are rare, alert-gated, and run on their own
+    thread). The returned ``incident_overhead_pct`` is pinned (max) in
+    the bench gate."""
+    import shutil
+    import tempfile
+
+    from featurenet_tpu.obs import incidents as _incidents
+
+    if obs.active():
+        raise RuntimeError(
+            "measure_incident_overhead installs and closes its own obs "
+            "run; close_run() the active run first"
+        )
+    tmp = run_dir or tempfile.mkdtemp(prefix="incident_overhead_")
+    obs.init_run(tmp, extra={"cmd": "incident_overhead"}, process_index=0)
+    service = _build_service(
+        cfg, buckets, max_wait_ms=2.0,
+        queue_limit=max(256, n_requests), rules=(),
+        slo_p99_ms=float("inf"),
+    )
+    grid = np.zeros((cfg.resolution,) * 3 + (1,), np.float32)
+
+    def closed_loop_qps() -> float:
+        t0 = time.perf_counter()
+        futs = [service.submit_voxels(grid) for _ in range(n_requests)]
+        for f in futs:
+            f.result(timeout=120.0)
+        return n_requests / (time.perf_counter() - t0)
+
+    manager = None
+    try:
+        service.batcher.trace_sample = 1.0   # tap on the hot emit path
+        closed_loop_qps()                    # JIT/page-cache warmup
+        dark = closed_loop_qps()             # no manager armed
+        manager = _incidents.arm(tmp)
+        armed = closed_loop_qps()
+    finally:
+        if manager is not None:
+            _incidents.disarm(manager)
+        service.drain()
+        obs.close_run()
+        if run_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "incident_overhead_pct": round(
+            max(0.0, (dark - armed) / dark * 100.0), 2
+        ) if dark > 0 else None,
+        "incident_dark_qps": round(dark, 1),
+        "incident_armed_qps": round(armed, 1),
+        "incident_overhead_requests": n_requests,
+    }
+
+
 def measure_quality_overhead(cfg, n_requests: int = 192,
                              buckets: Sequence[int] = (1, 4, 16),
                              run_dir: Optional[str] = None) -> dict:

@@ -79,6 +79,14 @@ METRIC_NAMES = frozenset(
         # store's series the same way.
         "scrape_failures_total",
         "scrape_duration_ms",
+        # Alert-timeline mirror (obs.alerts.set_store): fire=1/resolve=0
+        # per rule, appended straight to the store — dash and the report
+        # render alert timelines from the store alone.
+        "alerts_active",
+        # Incident plane (obs.incidents): currently-open incident count,
+        # exported by BOTH exporters so any scrape says whether the
+        # process is mid-incident.
+        "incidents_open",
     }
     | set(_EVENT_COUNTERS.values())
     # One gauge family per rolling window (quantile-labeled) + its count.
@@ -109,6 +117,8 @@ _HELP = {
     "connections_opened_total": "fresh pooled channels opened",
     "connections_reused_total": "pooled channel reuses",
     "connections_retired_total": "pooled channels retired by reason",
+    "alerts_active": "1 while the labeled alert rule is firing",
+    "incidents_open": "incidents currently open in this process",
 }
 
 
@@ -217,8 +227,19 @@ def render_metrics(service) -> str:
     row("trace_sampled_total", tc["sampled"], kind="counter")
     row("trace_forced_total", tc["forced"], kind="counter")
 
+    row("incidents_open", _incidents_open(), kind="gauge")
+
     _window_lines(lines)
     return "\n".join(lines) + "\n"
+
+
+def _incidents_open() -> int:
+    """Currently-open incident count (0 when the plane is unarmed) —
+    function-level import: incidents pulls tsdb/windows, and this module
+    must stay importable by the lightest exporter path."""
+    from featurenet_tpu.obs import incidents as _incidents
+
+    return _incidents.open_count()
 
 
 def _window_lines(lines: list[str]) -> None:
@@ -279,6 +300,8 @@ def render_router_metrics(router) -> str:
             )
     else:
         lines.append(f"{_PREFIX}connections_retired_total 0")
+
+    row("incidents_open", _incidents_open(), kind="gauge")
 
     _window_lines(lines)
     return "\n".join(lines) + "\n"

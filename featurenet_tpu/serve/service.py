@@ -92,7 +92,8 @@ class InferenceService:
                  batch_queue_limit: Optional[int] = None,
                  replica: Optional[str] = None,
                  quality=None,
-                 recorder=None):
+                 recorder=None,
+                 run_dir: Optional[str] = None):
         self.predictor = predictor
         self.cfg = predictor.cfg
         self.buckets = normalize_buckets(buckets)
@@ -180,6 +181,15 @@ class InferenceService:
             if (quality is not None or recorder is not None) else None,
             on_reject=self._on_reject if recorder is not None else None,
         )
+        # Incident plane (obs.incidents): with a run_dir this service
+        # owns the process-wide incident manager — an SLO alert firing
+        # over the windows above now freezes a diagnostic bundle under
+        # <run_dir>/incidents/ instead of being one line in the log.
+        self._incidents = None
+        if run_dir is not None:
+            from featurenet_tpu.obs import incidents as _incidents
+
+            self._incidents = _incidents.arm(run_dir)
         obs.emit("serve_start", buckets=list(self.buckets),
                  max_wait_ms=float(max_wait_ms), queue_limit=int(queue_limit))
         self._ready = True
@@ -428,6 +438,14 @@ class InferenceService:
         self._ready = False
         st = self.batcher.drain(timeout_s)
         _windows.flush()
+        # The final window cycle above may have resolved serving alerts
+        # (closing their incidents through the tap); disarm AFTER it so
+        # durations cover the real incident window.
+        if self._incidents is not None:
+            from featurenet_tpu.obs import incidents as _incidents
+
+            st["incidents"] = self._incidents.stats()
+            _incidents.disarm(self._incidents)
         if self.recorder is not None:
             self.recorder.close()
             st["capture"] = self.recorder.stats()

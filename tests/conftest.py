@@ -60,11 +60,23 @@ def rng():
 
 @pytest.fixture(autouse=True)
 def _reset_process_state():
+    # incidents.reset() disarms any leaked manager (uninstalling the
+    # event tap + clearing tracing force-all); alerts.set_store(None)
+    # drops a leaked alerts_active mirror that would otherwise write
+    # into a dead store across tests — the incident plane mirrors
+    # obs.close_run's discipline for its own process-wide slots.
+    from featurenet_tpu.obs import alerts as _alerts
+    from featurenet_tpu.obs import incidents as _incidents
+
     obs.close_run()
     faults.uninstall()
+    _incidents.reset()
+    _alerts.set_store(None)
     yield
     obs.close_run()
     faults.uninstall()
+    _incidents.reset()
+    _alerts.set_store(None)
 
 
 # --- slow tier ---------------------------------------------------------------

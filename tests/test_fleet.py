@@ -994,7 +994,16 @@ def test_fleet_e2e_burn_rate_scrape_alert_and_dash(
     burn-rate SLO fires during the slowdown and resolves after recovery,
     ``fleet_scale`` flips to ``add`` on sustained burn and ``hold``
     after, and the dashboard + report fleet timeline render from the
-    store ALONE once every serving process has exited."""
+    store ALONE once every serving process has exited.
+
+    ISSUE 20 rides the same fleet: the router owns an incident manager
+    (``run_dir=``), so the burn fire opens exactly ONE flap-damped
+    incident whose bundle carries the tsdb slice + events tail + folded
+    thread stacks; the replicas own their own managers (low in-process
+    SLO), so a replica-side bundle's stacks name the ``serve-batcher``
+    dispatcher thread; resolve closes the burn incident with a real
+    duration; and ``cli incident list/show`` render post-mortems from
+    the bundle directory alone after every serving process exited."""
     from featurenet_tpu.data.synthetic import generate_batch
     from featurenet_tpu.fleet.loadgen import http_load, replica_argv
     from featurenet_tpu.fleet.scraper import ROUTER_TARGET, MetricsScraper
@@ -1012,10 +1021,15 @@ def test_fleet_e2e_burn_rate_scrape_alert_and_dash(
     fault_for = {1: "replica_slow@request=1:every=1"}
 
     def spawn(slot, hb):
+        # slo_p99_ms=100 sits under the injected 250 ms forwards: the
+        # slow replica's own threshold alert fires IN-PROCESS, so its
+        # incident manager captures that process's stacks — the bundle
+        # that can name the serve-batcher dispatcher thread.
         return replica_argv(
             fleet_ckpt, slot, hb, run_dir=run_dir,
             exec_cache_dir=cache_dir, buckets="1,2", max_wait_ms=3.0,
-            queue_limit=64, inject_faults=fault_for.get(slot),
+            queue_limit=64, slo_p99_ms=100.0,
+            inject_faults=fault_for.get(slot),
         )
 
     store = _tsdb.TimeSeriesStore.open(run_dir)
@@ -1029,7 +1043,8 @@ def test_fleet_e2e_burn_rate_scrape_alert_and_dash(
     # slo_p99_ms=2000 keeps the THRESHOLD alerts (and the drain gate)
     # out of the story — this test is about the burn layer.
     router = FleetRouter(manager, slo_p99_ms=2000.0,
-                         scale_every_s=3600.0, store=store, slos=[rule])
+                         scale_every_s=3600.0, store=store, slos=[rule],
+                         run_dir=run_dir)
     srv = None
     try:
         manager.start()
@@ -1067,6 +1082,35 @@ def test_fleet_e2e_burn_rate_scrape_alert_and_dash(
         assert st_scale["burn_fast"] > 1.0, st_scale
         assert st_scale["burn_slow"] > 1.0, st_scale
         assert router._burn.active_alerts() == ["serving_p99_ms"]
+        # Before recycling the slow replica (a SIGKILL — no drain, so no
+        # capture-thread join), wait for ITS incident plane to finish a
+        # bundle: the in-process threshold rule only evaluates on the
+        # window-emit cadence inside observe() and the stack capture
+        # itself takes ~2 s, so on a loaded CI host the kill could land
+        # mid-capture and tear the one bundle whose folded stacks this
+        # test's post-hoc assertions need. No scrapes here: the burn
+        # layer's store state must not move while we wait.
+        from featurenet_tpu.obs import incidents as _incidents
+        from featurenet_tpu.obs import stacksampler as _stacksampler
+
+        def _replica_stacks_ready():
+            for b in _incidents.list_incidents(run_dir):
+                lb = _incidents.load_bundle(run_dir, b["id"])
+                if lb["stacks"] and "serve-batcher" in \
+                        _stacksampler.thread_totals(lb["stacks"]):
+                    return True
+            return False
+
+        t_cap = time.monotonic() + 180
+        while not _replica_stacks_ready():
+            assert time.monotonic() < t_cap, (
+                "no replica bundle with serve-batcher stacks before "
+                f"recycle: {_incidents.list_incidents(run_dir)}")
+            # A trickle keeps the slow replica's windows emitting (the
+            # threshold rule never evaluates on an idle service).
+            stats, _ = http_load("127.0.0.1", port, qps=20.0,
+                                 n_requests=8, grids=grids)
+            assert stats["dropped"] == 0, stats
         # --- recovery: clear the fault, recycle the slow replica ------
         del fault_for[1]
         assert manager.kill_one() == 1  # highest live slot = the slow one
@@ -1148,6 +1192,56 @@ def test_fleet_e2e_burn_rate_scrape_alert_and_dash(
     assert tl and ROUTER_TARGET in tl["targets"]
     assert tl["targets"]["1"]["samples"] > 0
     assert "fleet timeline" in format_report(rep)
+
+    # --- ISSUE 20: the incident plane, from the bundle dirs alone -----------
+    from featurenet_tpu.obs import incidents as _incidents
+    from featurenet_tpu.obs import stacksampler as _stacksampler
+
+    bundles = _incidents.list_incidents(run_dir)
+    assert bundles, "the burn fire should have opened an incident"
+    burn_b = [b for b in bundles if b.get("rule") == "serving_p99_ms_burn"]
+    # Flap damping: one fire/resolve pair -> exactly ONE incident, with
+    # the resolve closing it at a real duration.
+    assert len(burn_b) == 1, bundles
+    assert burn_b[0]["state"] == "closed", burn_b
+    assert burn_b[0]["duration_s"] > 0, burn_b
+    loaded = _incidents.load_bundle(run_dir, burn_b[0]["id"])
+    assert loaded["missing"] == [], loaded["missing"]
+    # The bundle is self-contained: a tsdb slice with real samples, the
+    # (force-sampled) request timelines in the events tail, the roster,
+    # and folded stacks of the capturing process.
+    slice_samples = sum(len(s["samples"])
+                       for s in loaded["tsdb"]["series"])
+    assert slice_samples > 0, loaded["tsdb"]
+    tail_kinds = {r.get("ev") for r in loaded["events_tail"]}
+    assert "request_done" in tail_kinds, sorted(tail_kinds)
+    assert loaded["roster"] is not None
+    assert loaded["stacks"], "folded stacks missing from the bundle"
+    # The replica-side incident (in-process threshold SLO breach on the
+    # slow replica) sampled ITS process: the batcher's dispatcher thread
+    # is named in some bundle's folded stacks.
+    all_threads: set = set()
+    for b in bundles:
+        lb = _incidents.load_bundle(run_dir, b["id"])
+        if lb["stacks"]:
+            all_threads |= set(_stacksampler.thread_totals(lb["stacks"]))
+    assert "serve-batcher" in all_threads, sorted(all_threads)
+    # The incident_open/close events joined the streams, and the report
+    # folds them into its incidents section.
+    assert rep["incidents"]["opened"] >= 1
+    assert "serving_p99_ms_burn" in rep["incidents"]["by_rule"]
+    assert "incidents:" in format_report(rep)
+    # The dash line knows about them too.
+    assert "incidents:" in render_frame(run_dir)
+    # And the CLI renders the post-mortem from the bundle dir alone.
+    cli_main(["incident", "list", run_dir])
+    out = capsys.readouterr().out
+    assert burn_b[0]["id"] in out
+    cli_main(["incident", "show", run_dir, burn_b[0]["id"]])
+    out = capsys.readouterr().out
+    assert burn_b[0]["id"] in out
+    assert "tsdb slice" in out and "stacks:" in out
+    assert "missing:" not in out
 
 
 # --- ISSUE 18: the acting autoscaler (unit) ----------------------------------

@@ -1,0 +1,512 @@
+"""Incident plane (ISSUE 20): alert-triggered diagnostic bundles
+(``obs.incidents``) + the host-side thread-stack sampler
+(``obs.stacksampler``).
+
+Unit coverage: the sampler (folded stacks with thread names, the
+render/parse round-trip, the hard wall-clock deadline keeping a partial
+profile), the manager's flap damping + cooldown through the REAL alert
+funnel (``alerts.fire`` → event tap), one-shot gate-regression and
+replica-loss-storm incidents, bundle contents and the atomic manifest,
+the go-dark discipline on bundle-write failure, degraded bundles (torn
+manifest, pruned pieces) rendering with a named ``missing`` section,
+oldest-first pruning, the ``alerts_active`` tsdb mirror, the
+``incidents_open`` /metrics gauge, the report's incidents section, the
+dash incidents line (friendly empty state included), and the CLI
+surfacing. The real-fleet acceptance e2e rides
+``test_fleet.test_fleet_e2e_burn_rate_scrape_alert_and_dash``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from featurenet_tpu import obs
+from featurenet_tpu.obs import alerts as _alerts
+from featurenet_tpu.obs import events as _events
+from featurenet_tpu.obs import incidents, stacksampler, tracing
+from featurenet_tpu.obs import tsdb as _tsdb
+
+RULE = _alerts.AlertRule("serving_p99_ms", ">", 50.0, "critical")
+
+
+def _fire(value: float = 123.0, window: int = 1, state: str = "fire",
+          rule=RULE) -> None:
+    """Drive the manager through the REAL funnel: threshold and burn
+    rules both land on ``alerts.fire``, which emits the ``alert`` event
+    the tap dispatches on."""
+    _alerts.fire(rule, value, window, state=state)
+
+
+def _wait(pred, timeout_s: float = 10.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.02)
+
+
+def _wait_captured(run_dir: str, incident_id: str,
+                   timeout_s: float = 15.0) -> dict:
+    """Until the capture thread has written the full bundle (the
+    manifest's ``files`` inventory is the capture-done marker)."""
+
+    def done():
+        b = incidents.load_bundle(run_dir, incident_id)
+        return bool((b["manifest"] or {}).get("files"))
+
+    _wait(done, timeout_s, f"capture of {incident_id}")
+    return incidents.load_bundle(run_dir, incident_id)
+
+
+# --- the stack sampler -------------------------------------------------------
+
+def test_stacksampler_names_threads_and_folds():
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(range(100))
+
+    th = threading.Thread(target=spin, name="busy-loop", daemon=True)
+    th.start()
+    try:
+        profile = stacksampler.sample_stacks(0.25, hz=100.0)
+    finally:
+        stop.set()
+        th.join()
+    assert profile["samples"] > 0 and profile["ticks"] > 0
+    assert not profile["truncated"]
+    totals = stacksampler.thread_totals(profile["folded"])
+    assert "busy-loop" in totals, totals
+    # The sampler never profiles itself (the calling thread).
+    assert "MainThread" not in totals, totals
+    # Folded frames are outermost-first ;-joined file:func entries.
+    busy = [s for s in profile["folded"] if s.startswith("busy-loop;")]
+    assert busy and any("spin" in s for s in busy), busy
+
+
+def test_stacksampler_render_parse_roundtrip():
+    folded = {"a;x.py:f;y.py:g": 7, "b;z.py:h": 2}
+    text = stacksampler.render_folded(
+        {"folded": folded, "samples": 9, "ticks": 9,
+         "duration_s": 1.0, "truncated": False}
+    )
+    # Count-descending "stack count" lines — the flamegraph idiom.
+    lines = text.strip().splitlines()
+    assert lines[0].endswith(" 7") and lines[1].endswith(" 2")
+    assert stacksampler.parse_folded(text) == folded
+    # Tolerant parse: junk lines are skipped, not raised on.
+    assert stacksampler.parse_folded("garbage\n" + text) == folded
+    assert stacksampler.thread_totals(folded) == {"a": 7, "b": 2}
+
+
+def test_stacksampler_hard_deadline_keeps_partial_profile():
+    # A 5 s profile against a 0.2 s wall: the sampler must stop AT the
+    # deadline and keep what it has, marked truncated — the recovery-
+    # matrix row for a sampler overrun.
+    t0 = time.monotonic()
+    profile = stacksampler.sample_stacks(5.0, hz=50.0, max_wall_s=0.2)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, elapsed
+    assert profile["truncated"]
+    assert profile["duration_s"] < 5.0
+
+
+# --- manager: open/close through the alert funnel ----------------------------
+
+def test_incident_lifecycle_flap_damping_and_cooldown(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    mgr = incidents.arm(run_dir, cooldown_s=0.4, sample_s=0.05)
+    assert incidents.arm(run_dir) is mgr  # idempotent per run_dir
+
+    _fire(state="fire")
+    assert mgr.open_count() == 1
+    assert tracing.force_all()  # incident mode: every request sampled
+    (inc_id,) = mgr.open_ids()
+    # A second fire of the SAME rule while open never opens another.
+    _fire(value=200.0, window=2, state="fire")
+    assert mgr.open_count() == 1
+    b = _wait_captured(run_dir, inc_id)
+    man = b["manifest"]
+    assert man["rule"] == "serving_p99_ms"
+    assert man["severity"] == "critical"
+    assert man["value"] == 123.0 and man["threshold"] == 50.0
+    assert man["state"] == "open" and man["pid"] == os.getpid()
+    assert set(man["files"]) >= {"tsdb.json", "windows.json",
+                                 "events_tail.jsonl", "stacks.folded"}
+    # Resolve closes with a real duration and drops force-sampling.
+    _fire(value=1.0, window=3, state="resolve")
+    assert mgr.open_count() == 0
+    assert not tracing.force_all()
+    entry = [e for e in incidents.list_incidents(run_dir)
+             if e["id"] == inc_id][0]
+    assert entry["state"] == "closed" and entry["duration_s"] >= 0.0
+    # Cooldown: an immediate re-fire is damped...
+    _fire(state="fire")
+    assert mgr.open_count() == 0
+    assert mgr.stats()["opened_total"] == 1
+    # ...and after the cooldown the same rule may open again.
+    time.sleep(0.45)
+    _fire(state="fire")
+    assert mgr.open_count() == 1
+    _fire(state="resolve")
+    incidents.disarm(mgr)
+    assert len(incidents.list_incidents(run_dir)) == 2
+    # The incident lifecycle joined the event stream.
+    from featurenet_tpu.obs.report import load_events
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    kinds = [e["ev"] for e in events]
+    assert kinds.count("incident_open") == 2
+    assert kinds.count("incident_close") == 2
+    assert "incident_capture" in kinds
+    obs.close_run()
+
+
+def test_incident_bundle_contents(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    # Seed the store and a membership roster so the bundle has both.
+    store = _tsdb.TimeSeriesStore.open(run_dir)
+    for i in range(10):
+        store.append("serving_ms", 100.0 + i, {"q": "0.99", "replica": "0"})
+    store.close()
+    from featurenet_tpu.elastic.membership import (
+        Membership,
+        write_membership,
+    )
+
+    write_membership(run_dir, Membership(
+        generation=3, members=(0, 1), min_world_size=1, reason="test",
+    ))
+    for _ in range(40):
+        obs.emit("probe", n=1)  # something for the events tail
+    mgr = incidents.arm(run_dir, sample_s=0.1, lookback_s=300.0)
+    _fire(state="fire")
+    (inc_id,) = mgr.open_ids()
+    b = _wait_captured(run_dir, inc_id)
+    assert b["missing"] == []
+    # tsdb slice: the seeded series, samples included, bounded lookback.
+    assert b["tsdb"]["lookback_s"] == 300.0
+    (series,) = [s for s in b["tsdb"]["series"]
+                 if s["metric"] == "serving_ms"]
+    assert len(series["samples"]) == 10
+    # roster verbatim; events tail re-tagged with its stream.
+    assert b["roster"]["generation"] == 3
+    tails = {r["stream"] for r in b["events_tail"]}
+    assert tails == {"events.jsonl"}
+    assert any(r["ev"] == "probe" for r in b["events_tail"])
+    # stacks: folded, thread-named (the capture thread samples, so the
+    # test's main thread IS visible here).
+    totals = stacksampler.thread_totals(b["stacks"])
+    assert totals, b["stacks"]
+    man = b["manifest"]
+    assert man["capture"]["stack_samples"] == sum(b["stacks"].values())
+    _fire(state="resolve")
+    incidents.disarm(mgr)
+    # The rendered post-mortem holds every section, no missing line.
+    text = incidents.format_incident(
+        incidents.load_bundle(run_dir, inc_id))
+    assert inc_id in text and "tsdb slice: " in text
+    assert "roster: 2 member(s)" in text
+    assert "events tail: " in text and "stacks: " in text
+    assert "missing:" not in text
+    obs.close_run()
+
+
+def test_one_shot_gate_regression_and_loss_storm(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    mgr = incidents.arm(run_dir, sample_s=0.05)
+    # The supervisor's gate_regression phase (its own standalone sink
+    # still routes through EventSink.emit, where the tap lives).
+    obs.emit("supervisor", phase="gate_regression",
+             failed=["mfu", "value"])
+    _wait(lambda: mgr.open_count() == 0 and mgr.stats()["opened_total"] == 1,
+          what="gate_regression capture+self-close")
+    (entry,) = incidents.list_incidents(run_dir)
+    assert entry["rule"] == "gate_regression"
+    assert entry["one_shot"] and entry["state"] == "closed"
+    b = incidents.load_bundle(run_dir, entry["id"])
+    assert b["manifest"]["failed"] == ["mfu", "value"]
+    assert "one-shot capture" in incidents.format_incident(b)
+    # Replica-loss storm: two losses are business as usual...
+    obs.emit("fleet_replica_loss", slot=0, inflight=0)
+    obs.emit("fleet_replica_loss", slot=1, inflight=0)
+    assert mgr.stats()["opened_total"] == 1
+    # ...the third inside the window is a correlated failure.
+    obs.emit("fleet_replica_loss", slot=0, inflight=0)
+    _wait(lambda: mgr.stats()["opened_total"] == 2 and mgr.open_count() == 0,
+          what="storm capture+self-close")
+    storm = [e for e in incidents.list_incidents(run_dir)
+             if e["rule"] == "replica_loss_storm"]
+    assert len(storm) == 1 and storm[0]["value"] == 3.0
+    incidents.disarm(mgr)
+    obs.close_run()
+
+
+def test_manager_goes_dark_on_bundle_write_failure(tmp_path, capsys):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    # <run_dir>/incidents is a FILE: every bundle makedirs fails — the
+    # ENOSPC shape without faking ENOSPC.
+    with open(incidents.incidents_dir(run_dir), "w") as fh:
+        fh.write("not a directory")
+    mgr = incidents.arm(run_dir, sample_s=0.05)
+    _fire(state="fire")
+    _wait(lambda: mgr.stats()["dark"], what="go-dark transition")
+    st = mgr.stats()
+    assert st["dropped"] >= 1
+    # One stderr warning, JSON like the sink's.
+    err = capsys.readouterr().err
+    warn = [ln for ln in err.splitlines() if "incident_error" in ln]
+    assert len(warn) == 1 and json.loads(warn[0])["dir"] == mgr.dir
+    # Dark: later fires drop silently, resolve doesn't raise, and the
+    # serving path never noticed (nothing above raised).
+    _fire(state="resolve")
+    time.sleep(0.45)
+    _fire(state="fire")
+    assert mgr.stats()["opened_total"] == 1
+    incidents.disarm(mgr)
+    obs.close_run()
+
+
+def test_bundle_pruning_keeps_newest(tmp_path):
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    mgr = incidents.arm(run_dir, cooldown_s=0.0, sample_s=0.02,
+                        max_bundles=2)
+    for i in range(4):
+        _fire(value=100.0 + i, window=i, state="fire")
+        (inc_id,) = mgr.open_ids()
+        _wait_captured(run_dir, inc_id)
+        _fire(window=i, state="resolve")
+        time.sleep(0.002)  # distinct epoch-ms ids
+    incidents.disarm(mgr)
+    kept = incidents.list_incidents(run_dir)
+    assert len(kept) == 2, kept
+    # Ids sort chronologically; the two NEWEST survive.
+    assert kept[-1]["value"] == 103.0
+    obs.close_run()
+
+
+# --- degraded bundles (satellite: damage renders, never tracebacks) ----------
+
+def _one_closed_incident(run_dir: str) -> str:
+    mgr = incidents.arm(run_dir, sample_s=0.05)
+    _fire(state="fire")
+    (inc_id,) = mgr.open_ids()
+    _wait_captured(run_dir, inc_id)
+    _fire(state="resolve")
+    incidents.disarm(mgr)
+    return inc_id
+
+
+def test_degraded_bundles_name_whats_missing(tmp_path, capsys):
+    from featurenet_tpu.cli import main as cli_main
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    inc_id = _one_closed_incident(run_dir)
+    obs.close_run()
+    bundle = os.path.join(incidents.incidents_dir(run_dir), inc_id)
+    # Torn manifest (half a JSON object), pruned tsdb slice, vanished
+    # stacks: the three damage shapes of a crashed/pruned capture.
+    with open(os.path.join(bundle, "manifest.json"), "w") as fh:
+        fh.write('{"id": "torn...')
+    os.unlink(os.path.join(bundle, "tsdb.json"))
+    os.unlink(os.path.join(bundle, "stacks.folded"))
+    b = incidents.load_bundle(run_dir, inc_id)
+    assert "manifest.json (torn/unparseable JSON)" in b["missing"]
+    assert "tsdb.json (absent)" in b["missing"]
+    assert "stacks.folded (absent)" in b["missing"]
+    # The list survives too: a damaged manifest is a named state.
+    (entry,) = incidents.list_incidents(run_dir)
+    assert entry["state"] == "damaged"
+    # And the CLI renders the post-mortem NAMING the damage — exit 0,
+    # no traceback.
+    cli_main(["incident", "show", run_dir, inc_id])
+    out = capsys.readouterr().out
+    assert "missing:" in out
+    assert "tsdb.json (absent)" in out
+    assert "manifest.json (torn/unparseable JSON)" in out
+    cli_main(["incident", "list", run_dir])
+    assert "state=damaged" in capsys.readouterr().out
+
+
+def test_cli_incident_empty_and_unknown(tmp_path, capsys):
+    from featurenet_tpu.cli import main as cli_main
+
+    run_dir = str(tmp_path / "empty")
+    os.makedirs(run_dir)
+    cli_main(["incident", "list", run_dir])
+    assert "no incident bundles" in capsys.readouterr().out
+    with pytest.raises(SystemExit, match="no bundles"):
+        cli_main(["incident", "show", run_dir])
+    with pytest.raises(SystemExit, match="no bundle 'inc-x'"):
+        cli_main(["incident", "show", run_dir, "inc-x"])
+
+
+def test_cli_incident_show_json_and_latest_default(tmp_path, capsys):
+    from featurenet_tpu.cli import main as cli_main
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    inc_id = _one_closed_incident(run_dir)
+    obs.close_run()
+    # show with no id renders the latest bundle; --json round-trips.
+    cli_main(["incident", "show", run_dir])
+    assert inc_id in capsys.readouterr().out
+    cli_main(["incident", "show", run_dir, "--json"])
+    b = json.loads(capsys.readouterr().out)
+    assert b["id"] == inc_id and b["missing"] == []
+    cli_main(["incident", "list", run_dir, "--json"])
+    (entry,) = json.loads(capsys.readouterr().out)
+    assert entry["id"] == inc_id and entry["state"] == "closed"
+
+
+# --- surfacing: mirror series, /metrics, report, dash ------------------------
+
+def test_alerts_active_mirror_series(tmp_path):
+    run_dir = str(tmp_path / "run")
+    store = _tsdb.TimeSeriesStore.open(run_dir)
+    _alerts.set_store(store)
+    try:
+        _alerts.fire(RULE, 123.0, 1, state="fire")
+        _alerts.fire(RULE, 1.0, 2, state="resolve")
+    finally:
+        _alerts.set_store(None)
+        store.close()
+    reader = _tsdb.TimeSeriesStore.open(run_dir)
+    samples = reader.query("alerts_active",
+                           {"rule": "serving_p99_ms"}, since_s=3600.0)
+    assert [v for _t, v in samples] == [1.0, 0.0]
+    # Detached: firing writes nothing (and raises nothing).
+    _alerts.fire(RULE, 99.0, 3, state="fire")
+    reader2 = _tsdb.TimeSeriesStore.open(run_dir)
+    assert len(reader2.query("alerts_active",
+                             {"rule": "serving_p99_ms"},
+                             since_s=3600.0)) == 2
+
+
+def test_metrics_export_incidents_open_gauge(tmp_path):
+    from featurenet_tpu.serve.metrics import METRIC_NAMES, render_metrics
+
+    assert "incidents_open" in METRIC_NAMES
+    assert "alerts_active" in METRIC_NAMES  # the mirror's series name
+
+    stub = SimpleNamespace(
+        cfg=SimpleNamespace(
+            serve_precision="fp32",
+            arch=SimpleNamespace(conv_backend="reference"),
+        ),
+        health=lambda: {"ready": True, "uptime_s": 1.0, "window_seq": 0},
+        stats=lambda: {"served": 0, "rejected": 0, "errors": 0,
+                       "queue_depth": 0, "occupancy": 0.0},
+    )
+    (line,) = [ln for ln in render_metrics(stub).splitlines()
+               if ln.startswith("featurenet_incidents_open ")]
+    assert line.endswith(" 0")
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    mgr = incidents.arm(run_dir, sample_s=0.05)
+    _fire(state="fire")
+    (line,) = [ln for ln in render_metrics(stub).splitlines()
+               if ln.startswith("featurenet_incidents_open ")]
+    assert line.endswith(" 1")
+    _fire(state="resolve")
+    incidents.disarm(mgr)
+    obs.close_run()
+
+
+def test_report_incidents_section(tmp_path):
+    from featurenet_tpu.obs.report import (
+        build_report,
+        build_report_dir,
+        format_report,
+    )
+
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    inc_id = _one_closed_incident(run_dir)
+    obs.close_run()
+    from featurenet_tpu.obs.report import load_events
+
+    events, bad = load_events(run_dir)
+    assert bad == 0
+    rep = build_report(events)
+    sec = rep["incidents"]
+    assert sec["opened"] == 1 and sec["closed"] == 1
+    assert sec["by_rule"] == {"serving_p99_ms": 1}
+    assert sec["still_open"] == []
+    assert sec["durations_s"] and sec["durations_s"][0] >= 0.0
+    text = format_report(rep)
+    assert "incidents: 1 opened, 1 closed" in text
+    # build_report_dir also inventories the on-disk bundles.
+    rep_d = build_report_dir(run_dir)
+    (bundle,) = rep_d["incidents"]["bundles"]
+    assert bundle["id"] == inc_id
+    assert inc_id in format_report(rep_d)
+    # An open-without-close event trail renders a STILL OPEN flag.
+    open_only = [e for e in events if e["ev"] != "incident_close"]
+    rep2 = build_report(open_only)
+    assert rep2["incidents"]["still_open"] == [inc_id]
+    assert "STILL OPEN" in format_report(rep2)
+
+
+def test_dash_incident_line(tmp_path, capsys):
+    from featurenet_tpu.cli import main as cli_main
+    from featurenet_tpu.obs.dash import render_frame
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    # Friendly empty states on BOTH axes: no tsdb series AND no
+    # incidents — `cli dash --once` must stay CI-renderable anywhere.
+    frame = render_frame(empty)
+    assert "incidents: none recorded" in frame
+    cli_main(["dash", empty, "--once"])
+    assert "incidents: none recorded" in capsys.readouterr().out
+    run_dir = str(tmp_path / "run")
+    obs.init_run(run_dir, extra={"cmd": "t"}, process_index=0)
+    store = _tsdb.TimeSeriesStore.open(run_dir)
+    store.append("ready", 1.0, {"replica": "0"})
+    store.close()
+    inc_id = _one_closed_incident(run_dir)
+    obs.close_run()
+    frame = render_frame(run_dir)
+    assert (f"incidents: 0 open · 1 recent · last {inc_id} "
+            f"(serving_p99_ms, closed)") in frame
+
+
+# --- registries + the overhead probe's precondition --------------------------
+
+def test_incident_kinds_in_event_registry():
+    from featurenet_tpu.obs.report import (
+        KNOWN_EVENT_KINDS,
+        REQUIRED_EVENT_FIELDS,
+    )
+
+    for kind in ("incident_open", "incident_capture", "incident_close"):
+        assert kind in KNOWN_EVENT_KINDS
+    assert REQUIRED_EVENT_FIELDS["incident_open"] == (
+        "id", "rule", "severity", "value")
+    assert REQUIRED_EVENT_FIELDS["incident_capture"] == ("id", "files")
+    assert REQUIRED_EVENT_FIELDS["incident_close"] == (
+        "id", "rule", "duration_s")
+
+
+def test_incident_overhead_probe_refuses_active_run(tmp_path):
+    from featurenet_tpu.serve.loadgen import measure_incident_overhead
+
+    obs.init_run(str(tmp_path / "run"), extra={"cmd": "t"},
+                 process_index=0)
+    with pytest.raises(RuntimeError, match="close_run"):
+        measure_incident_overhead(None)
+    obs.close_run()
